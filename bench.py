@@ -1,0 +1,130 @@
+"""Headline benchmark: ResNet-50 ImageNet training throughput per chip.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+   "value": N, "unit": "images/sec", "vs_baseline": N}
+
+Baseline: the reference (dawdle/mxnet v0.5) publishes no ResNet-50 number
+(the model postdates it). The closest published anchor in the same
+FLOP class (~4 GFLOPs/image) is Inception-BN at 97 img/s on 1x GTX 980 with
+cuDNN v3 (reference example/imagenet/README.md:40, mirrored in BASELINE.md),
+so vs_baseline = value / 97.0 — "how much faster than the reference's best
+same-class single-device training throughput".
+
+Method: fused train step (forward + backward + SGD-momentum update in one
+donated XLA program), bf16 compute / f32 master params, synthetic on-device
+data (the input pipeline is benchmarked separately; the reference's numbers
+are likewise decode-bound only beyond 3000 img/s, README:5). Warmup 2 steps
+(compile), then timed steps with a hard device sync at the end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_resnet50_train_step(batch_size, lr=0.1, momentum=0.9):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.executor import _build_graph_fn
+    from mxnet_tpu.models import resnet50
+
+    sym = resnet50(num_classes=1000)
+    input_shapes = {"data": (batch_size, 3, 224, 224),
+                    "softmax_label": (batch_size,)}
+    arg_shapes, _, aux_shapes = sym.infer_shape(**input_shapes)
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+
+    rng = np.random.RandomState(0)
+    params = {}
+    for name, shape in zip(arg_names, arg_shapes):
+        if name in input_shapes:
+            continue
+        scale = 0.1 if name.endswith(("gamma", "bias", "beta")) else \
+            float(np.sqrt(2.0 / max(1, int(np.prod(shape[1:])))))
+        if name.endswith("gamma"):
+            params[name] = jnp.ones(shape, jnp.float32)
+        elif name.endswith(("beta", "bias")):
+            params[name] = jnp.zeros(shape, jnp.float32)
+        else:
+            params[name] = jnp.asarray((rng.randn(*shape) * scale).astype(np.float32))
+    aux = {name: (jnp.ones(s, jnp.float32) if name.endswith("var")
+                  else jnp.zeros(s, jnp.float32))
+           for name, s in zip(aux_names, aux_shapes)}
+    moms = {k: jnp.zeros_like(v) for k, v in params.items()}
+
+    graph_fn = _build_graph_fn(sym, is_train=True)
+    zero_key = jnp.zeros((2,), jnp.uint32)
+    rescale = 1.0 / batch_size
+
+    def step(params, moms, aux, data, label):
+        def loss_fn(p):
+            p_c = {k: v.astype(jnp.bfloat16) for k, v in p.items()}
+            outs, new_aux = graph_fn(
+                {**p_c, "data": data.astype(jnp.bfloat16), "softmax_label": label},
+                aux, zero_key)
+            return jnp.sum(outs[0].astype(jnp.float32)), new_aux
+
+        grads, new_aux = jax.grad(loss_fn, has_aux=True)(params)
+        new_moms = {k: momentum * moms[k] + grads[k] * rescale for k in params}
+        new_params = {k: params[k] - lr * new_moms[k] for k in params}
+        return new_params, new_moms, new_aux
+
+    jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+    return jitted, params, moms, aux
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"bench device: {dev}", file=sys.stderr)
+
+    step, params, moms, aux = build_resnet50_train_step(args.batch_size)
+    rng = np.random.RandomState(0)
+    data = jax.device_put(rng.randn(args.batch_size, 3, 224, 224).astype(np.float32))
+    label = jax.device_put(
+        rng.randint(0, 1000, (args.batch_size,)).astype(np.float32))
+
+    import jax.numpy as jnp
+
+    def fence():
+        # Through the remote-TPU tunnel, block_until_ready acks before the
+        # device queue drains; a scalar readback is the only honest sync.
+        return float(jnp.sum(params["fc1_bias"]))
+
+    for _ in range(args.warmup):
+        params, moms, aux = step(params, moms, aux, data, label)
+    fence()
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, moms, aux = step(params, moms, aux, data, label)
+    fence()
+    dt = time.perf_counter() - t0
+
+    images_per_sec = args.batch_size * args.steps / dt
+    baseline = 97.0  # Inception-BN img/s, 1x GTX 980 cuDNN v3 (BASELINE.md)
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

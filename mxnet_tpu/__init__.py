@@ -1,0 +1,52 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with the capability surface
+of early MXNet (the v0.5-era reference), built on JAX/XLA/pjit/Pallas.
+
+Layering (cf. SURVEY.md §1):
+  context/base/engine      - device model, errors, host async engine
+  ndarray/random           - imperative tensors over jax.Array
+  ops/                     - operator library (registry + pure-fn kernels)
+  symbol/executor          - symbolic graphs tracing to jitted XLA programs
+  io/                      - data iterators (RecordIO/MNIST/NDArray, prefetch)
+  kvstore                  - data-parallel parameter sync over mesh collectives
+  model/optimizer/metric/  - FeedForward trainer stack
+  initializer/callback
+  parallel/                - meshes, shard specs, collectives, ring attention
+  models/                  - the model zoo (MLP..ResNet-50, LSTM, transformer)
+"""
+
+from . import base, context, engine
+from .base import MXNetError
+from .context import Context, cpu, cpu_pinned, current_context, gpu, num_devices, tpu
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from .ndarray import NDArray
+
+from . import ops
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol, Variable, Group
+from .executor import Executor
+
+from . import initializer as init
+from . import initializer
+from . import io
+from . import kvstore as kv
+from . import kvstore
+from . import metric
+from . import optimizer
+from . import callback
+from . import lr_scheduler
+from . import visualization as viz
+from . import visualization
+from . import monitor
+from .monitor import Monitor
+from . import operator
+from . import model
+from .model import FeedForward
+from . import recordio
+from . import parallel
+from . import models
+from . import utils
+
+__version__ = "0.1.0"

@@ -1,0 +1,123 @@
+"""Shared base utilities: error type, env-var config, and a generic registry.
+
+TPU-native counterparts of the reference's dmlc-core surface:
+  - ``MXNetError``          <- error propagation across the C API
+    (reference: python/mxnet/base.py, src/c_api/c_api_error.h)
+  - ``env_int``/``env_bool``<- runtime env-var tuning catalog (doc/env_var.md)
+  - ``Registry``            <- dmlc::Registry used by ops/iterators/optimizers
+There is no FFI boundary here: the package is pure Python over JAX, with
+optional native helpers loaded via ctypes (see mxnet_tpu/native).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["MXNetError", "MXNetTPUError", "env_int", "env_bool", "env_str", "Registry"]
+
+
+class MXNetError(Exception):
+    """Framework error type (name kept for reference-API parity)."""
+
+
+# Idiomatic alias.
+MXNetTPUError = MXNetError
+
+
+def env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return default
+    return v.lower() not in ("0", "false", "off", "no")
+
+
+def env_str(name: str, default: str) -> str:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+class Registry:
+    """A named registry of factories (counterpart of dmlc::Registry).
+
+    >>> OPTIMIZERS = Registry('optimizer')
+    >>> @OPTIMIZERS.register('sgd')
+    ... class SGD: ...
+    >>> OPTIMIZERS.create('sgd')
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries: dict[str, type] = {}
+
+    def register(self, name=None):
+        def _reg(obj, name=name):
+            key = (name or obj.__name__).lower()
+            if key in self._entries and self._entries[key] is not obj:
+                raise MXNetError(f"duplicate {self.kind} registration: {key}")
+            self._entries[key] = obj
+            obj.registry_name = key
+            return obj
+
+        if isinstance(name, str) or name is None:
+            return _reg
+        # used as bare decorator: @REG.register
+        obj, name = name, None
+        return _reg(obj)
+
+    def get(self, name: str):
+        key = name.lower()
+        if key not in self._entries:
+            raise MXNetError(
+                f"unknown {self.kind} {name!r}; registered: {sorted(self._entries)}"
+            )
+        return self._entries[key]
+
+    def create(self, name: str, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+    def __contains__(self, name):
+        return name.lower() in self._entries
+
+    def names(self):
+        return sorted(self._entries)
+
+
+_DTYPE_TO_CODE = {
+    np.dtype("float32"): 0,
+    np.dtype("float64"): 1,
+    np.dtype("float16"): 2,
+    np.dtype("uint8"): 3,
+    np.dtype("int32"): 4,
+    np.dtype("int8"): 5,
+    np.dtype("int64"): 6,
+    # TPU-native additions beyond the reference's float32-only world:
+    np.dtype("bool"): 7,
+}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+
+
+def dtype_code(dt) -> int:
+    """Stable integer code for a dtype (used by the save/load file format)."""
+    dt = np.dtype(dt)
+    if dt.name == "bfloat16":
+        return 8
+    if dt not in _DTYPE_TO_CODE:
+        raise MXNetError(f"unsupported dtype {dt}")
+    return _DTYPE_TO_CODE[dt]
+
+
+def dtype_from_code(code: int):
+    if code == 8:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    if code not in _CODE_TO_DTYPE:
+        raise MXNetError(f"unknown dtype code {code}")
+    return _CODE_TO_DTYPE[code]

@@ -1,0 +1,258 @@
+"""Executor: binds a Symbol to devices and buffers, compiles it with XLA.
+
+Reference counterpart: src/symbol/graph_executor.cc (GraphExecutor) —
+which plans memory (inplace rewrite, shared-storage coloring), creates cached
+engine ops, and pushes them in topo order on every Forward/Backward. Here all
+of that collapses into ``jax.jit``:
+
+  - graph → function     : the Symbol is walked once into a pure function;
+                           tracing it yields the jaxpr (≙ StaticGraph).
+  - MakeBackwardPass      : ``jax.vjp`` inside a jitted gradient function
+                           (reference: static_graph.cc:192-294).
+  - memory planner        : XLA buffer assignment + donation
+                           (reference: graph_memory_allocator.h).
+  - cached engine ops     : the compiled executable, cached by shapes.
+  - Forward/Backward push : one async dispatch of a single fused program.
+
+``backward()`` recompiles forward+backward as one fused program; XLA shares
+the forward subcomputation, so an explicit ``forward(is_train=True)`` +
+``backward()`` pair costs one extra forward vs. the fused train-step path the
+FeedForward trainer uses (model.py).
+
+``debug_str()`` exposes the compiled HLO and per-executable memory stats,
+keeping the reference's memory-plan introspection story
+(graph_executor.cc:584-614, example/memcost).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import random as _random
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray, zeros
+
+__all__ = ["Executor", "simple_bind"]
+
+
+def _build_graph_fn(symbol, is_train: bool):
+    """Compile the symbol DAG into a pure function of (args, aux, rng)."""
+    nodes = symbol._topo()
+
+    def fn(arg_values: dict, aux_values: dict, rng):
+        env = {}
+        new_aux = dict(aux_values)
+        for i, node in enumerate(nodes):
+            if node.is_variable:
+                env[(id(node), 0)] = arg_values[node.name]
+                continue
+            ins = [env[(src_id, k)] for src_id, k in
+                   [(id(s), k) for s, k in node.inputs]]
+            aux_names = [f"{node.name}_{a}" for a in node.op.list_auxiliary_states()]
+            aux = [aux_values[a] for a in aux_names]
+            key = jax.random.fold_in(rng, i) if node.op.need_rng else None
+            outs, updated = node.op.fwd(ins, aux, is_train, key)
+            for k, o in enumerate(outs):
+                env[(id(node), k)] = o
+            for a_name, a_val in zip(aux_names, updated):
+                new_aux[a_name] = a_val
+        outputs = tuple(env[(id(n), i)] for n, i in symbol._heads)
+        return outputs, new_aux
+
+    return fn
+
+
+def _normalize(names, values, what):
+    if values is None:
+        return {}
+    if isinstance(values, dict):
+        return dict(values)
+    values = list(values)
+    if len(values) != len(names):
+        raise MXNetError(f"{what}: expected {len(names)} entries, got {len(values)}")
+    return dict(zip(names, values))
+
+
+class Executor:
+    """A bound computation (reference: include/mxnet/symbolic.h Executor)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self.arg_dict = _normalize(arg_names, args, "args")
+        missing = [n for n in arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError(f"bind: missing arguments {missing}")
+        self.grad_dict = _normalize(arg_names, args_grad, "args_grad")
+        self.aux_dict = _normalize(aux_names, aux_states, "aux_states")
+        if set(aux_names) - set(self.aux_dict):
+            raise MXNetError(
+                f"bind: missing aux states {sorted(set(aux_names) - set(self.aux_dict))}"
+            )
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in arg_names}
+        else:
+            self.grad_req = dict(_normalize(arg_names, grad_req, "grad_req"))
+        for n in arg_names:
+            self.grad_req.setdefault(n, "null")
+
+        self._fwd_fns = {}  # is_train -> jitted fn
+        self._bwd_fn = None
+        self._outputs: list[NDArray] | None = None
+        self._last = None  # (arg_vals, aux_vals, rng) of last is_train fwd
+        self._needs_rng = any(
+            (not n.is_variable) and n.op.need_rng for n in symbol._topo()
+        )
+
+    # -- public surface -------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._symbol.list_auxiliary_states()]
+
+    @property
+    def outputs(self):
+        if self._outputs is None:
+            raise MXNetError("call forward() before reading outputs")
+        return self._outputs
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k!r}")
+            src = v if isinstance(v, NDArray) else NDArray(v)
+            src.copyto(self.arg_dict[k])
+        arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+        aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        rng = _random.next_key() if self._needs_rng else jnp.zeros((2,), jnp.uint32)
+
+        is_train = bool(is_train)
+        if is_train not in self._fwd_fns:
+            fn = _build_graph_fn(self._symbol, is_train)
+            self._fwd_fns[is_train] = jax.jit(fn)
+        outs, new_aux = self._fwd_fns[is_train](arg_vals, aux_vals, rng)
+
+        if is_train:
+            self._last = (arg_vals, aux_vals, rng)
+            for n, a in self.aux_dict.items():
+                a._set_data(new_aux[n])
+        if self._outputs is None:
+            self._outputs = [NDArray(o) for o in outs]
+        else:
+            for holder, o in zip(self._outputs, outs):
+                holder._data = o  # outputs are framework-owned; bypass writable
+        return self._outputs
+
+    def backward(self, out_grads=None):
+        """Compute gradients into the bound grad arrays (reference:
+        GraphExecutor::Backward). Seeds ones for missing head gradients; loss
+        heads ignore the seed by construction (see ops/loss.py)."""
+        if self._last is None:
+            raise MXNetError("backward() requires a prior forward(is_train=True)")
+        arg_vals, aux_vals, rng = self._last
+        diff_names = sorted(n for n, r in self.grad_req.items() if r != "null")
+        if not diff_names:
+            return
+        if self._bwd_fn is None:
+            fwd = _build_graph_fn(self._symbol, True)
+
+            def bwd(diff_args, other_args, aux, rng, cotangents):
+                def f(d):
+                    outs, _ = fwd({**d, **other_args}, aux, rng)
+                    return outs
+
+                _, vjp_fn = jax.vjp(f, diff_args)
+                (grads,) = vjp_fn(cotangents)
+                return grads
+
+            self._bwd_fn = jax.jit(bwd)
+
+        if out_grads is None:
+            cots = tuple(jnp.ones_like(o._data) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = tuple(g._data for g in out_grads)
+        diff_args = {n: arg_vals[n] for n in diff_names}
+        other = {n: v for n, v in arg_vals.items() if n not in diff_args}
+        grads = self._bwd_fn(diff_args, other, aux_vals, rng, cots)
+        for n in diff_names:
+            req = self.grad_req[n]
+            holder = self.grad_dict.get(n)
+            if holder is None:
+                continue
+            g = grads[n].astype(holder.dtype)
+            if req == "add":
+                holder._set_data(holder._data + g)
+            else:  # write
+                holder._set_data(g)
+
+    def copy_params_from(self, arg_params, aux_params=None):
+        """Copy parameter dicts into the bound arrays (reference:
+        Executor::CopyParamsFrom used by FeedForward)."""
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                arr.copyto(self.arg_dict[name])
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    arr.copyto(self.aux_dict[name])
+
+    def debug_str(self) -> str:
+        """Compiled-program introspection (reference: GraphExecutor::Print —
+        'Total N MB allocated'); reports XLA memory analysis when compiled."""
+        lines = [self._symbol.debug_str()]
+        fn = self._fwd_fns.get(True) or self._fwd_fns.get(False)
+        if fn is not None:
+            arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+            aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+            rng = jnp.zeros((2,), jnp.uint32)
+            compiled = fn.lower(arg_vals, aux_vals, rng).compile()
+            try:
+                mem = compiled.memory_analysis()
+                total = getattr(mem, "temp_size_in_bytes", 0) + getattr(
+                    mem, "output_size_in_bytes", 0
+                )
+                lines.append(f"Total {total / (1 << 20):.4f} MB allocated")
+                lines.append(
+                    f"Temp {getattr(mem, 'temp_size_in_bytes', 0) / (1 << 20):.4f} MB, "
+                    f"args {getattr(mem, 'argument_size_in_bytes', 0) / (1 << 20):.4f} MB"
+                )
+            except Exception:  # memory_analysis availability varies by backend
+                lines.append("Total memory: unavailable on this backend")
+        return "\n".join(lines)
+
+
+def simple_bind(symbol, ctx, grad_req="write", **input_shapes) -> Executor:
+    """Allocate all buffers from inferred shapes and bind (reference:
+    symbol.py simple_bind → MXExecutorBind)."""
+    arg_shapes, _, aux_shapes = symbol.infer_shape(**input_shapes)
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    args = {n: zeros(s, ctx) for n, s in zip(arg_names, arg_shapes)}
+    if isinstance(grad_req, str):
+        reqs = {n: grad_req for n in arg_names}
+    elif isinstance(grad_req, dict):
+        reqs = {n: grad_req.get(n, "null") for n in arg_names}
+    else:
+        reqs = dict(zip(arg_names, grad_req))
+    grads = {
+        n: zeros(s, ctx)
+        for n, s in zip(arg_names, arg_shapes)
+        if reqs.get(n, "null") != "null"
+    }
+    aux = {n: zeros(s, ctx) for n, s in zip(aux_names, aux_shapes)}
+    return Executor(symbol, ctx, args, grads, reqs, aux)

@@ -1,0 +1,471 @@
+"""Data IO: iterators over batches (reference: src/io/ + python/mxnet/io.py).
+
+The reference composes C++ decorator iterators
+(PrefetcherIter(BatchLoader(ImageNormalizeIter(ImageRecordIter())))) with
+OpenMP JPEG decode and a background prefetch thread. Here:
+
+  - ``NDArrayIter``     in-memory batching with the reference's pad/round-batch
+                        semantics (python/mxnet/io.py:89-194).
+  - ``MNISTIter``       idx-format loader with shuffle/flat/partitioning
+                        (src/io/iter_mnist.cc).
+  - ``ImageRecordIter`` RecordIO shards -> decode -> augment -> normalize ->
+                        batch, with worker-thread decode and double-buffered
+                        prefetch through the host engine (src/io/iter_image_recordio.cc).
+                        Decode runs in the C++ native helper when built, else PIL.
+  - ``PrefetchingIter`` generic prefetch decorator (src/io/iter_prefetcher.h).
+
+Distributed sharding follows the reference: ``num_parts``/``part_index``
+split the record stream per worker (InputSplit semantics); the trainer sets
+these from the process topology.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..base import MXNetError, env_int
+from ..engine import engine
+from ..ndarray import NDArray, array
+
+__all__ = ["DataBatch", "DataIter", "NDArrayIter", "MNISTIter", "ImageRecordIter",
+           "PrefetchingIter", "CSVIter"]
+
+
+class DataBatch:
+    """One batch: data/label NDArrays + pad count (reference: include/mxnet/io.h:60)."""
+
+    def __init__(self, data, label, pad=0, index=None):
+        self.data = data if isinstance(data, list) else [data]
+        self.label = label if isinstance(label, list) else [label]
+        self.pad = pad
+        self.index = index
+
+
+class DataIter:
+    """Base iterator (reference: IIterator<DataBatch> + python DataIter)."""
+
+    def __init__(self):
+        self.batch_size = 0
+
+    def reset(self):
+        raise NotImplementedError
+
+    def next(self):
+        """Return the next DataBatch or raise StopIteration."""
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    # reference iterators expose these accessors for the "current" batch
+    @property
+    def provide_data(self):
+        """List of (name, shape) for data."""
+        raise NotImplementedError
+
+    @property
+    def provide_label(self):
+        raise NotImplementedError
+
+    def getpad(self):
+        return 0
+
+
+class NDArrayIter(DataIter):
+    """Batching over in-memory arrays with reference pad semantics:
+    the last partial batch wraps around to the epoch start and reports
+    ``pad`` = number of wrapped samples (python/mxnet/io.py:89-194)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__()
+        self.data = self._to_np(data)
+        n = self.data.shape[0]
+        self.label = self._to_np(label) if label is not None else np.zeros((n,), np.float32)
+        if self.label.shape[0] != n:
+            raise MXNetError("data/label count mismatch")
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.data_name, self.label_name = data_name, label_name
+        self.num_data = n
+        if n < batch_size:
+            raise MXNetError("batch_size larger than dataset")
+        self._order = np.arange(n)
+        self.cursor = -batch_size
+        self.reset()
+
+    @staticmethod
+    def _to_np(x):
+        if isinstance(x, NDArray):
+            return x.asnumpy()
+        return np.asarray(x)
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self._order)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "roll_over":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        return DataBatch(
+            [array(self._take(self.data))],
+            [array(self._take(self.label))],
+            pad=self.getpad(),
+        )
+
+    def _take(self, arr):
+        end = self.cursor + self.batch_size
+        if end <= self.num_data:
+            idx = self._order[self.cursor : end]
+        else:  # pad: wrap around to the beginning (reference round_batch)
+            idx = np.concatenate(
+                [self._order[self.cursor :], self._order[: end - self.num_data]]
+            )
+        return arr[idx]
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+    @property
+    def provide_data(self):
+        return [(self.data_name, (self.batch_size,) + self.data.shape[1:])]
+
+    @property
+    def provide_label(self):
+        return [(self.label_name, (self.batch_size,) + self.label.shape[1:])]
+
+
+def _read_idx_file(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0:
+            raise MXNetError(f"{path}: not an idx file")
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        dtype = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+                 0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}[dtype_code]
+        data = np.frombuffer(f.read(), dtype=dtype)
+        return data.reshape(dims)
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format loader (reference: src/io/iter_mnist.cc) with
+    flat/4-D output, shuffle, silent=?, and num_parts/part_index sharding."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=False, flat=False,
+                 seed=0, silent=True, num_parts=1, part_index=0,
+                 input_shape=None, **_ignored):
+        super().__init__()
+        images = _read_idx_file(image).astype(np.float32) / 255.0
+        labels = _read_idx_file(label).astype(np.float32)
+        # partition for distributed workers (InputSplit semantics)
+        n = images.shape[0]
+        per = n // num_parts
+        lo, hi = per * part_index, per * (part_index + 1) if part_index < num_parts - 1 else n
+        images, labels = images[lo:hi], labels[lo:hi]
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images.reshape(images.shape[0], 1, images.shape[1], images.shape[2])
+            if input_shape is not None and tuple(input_shape) != images.shape[1:]:
+                images = images.reshape((images.shape[0],) + tuple(input_shape))
+        if shuffle:
+            # seed BEFORE the inner iterator shuffles its first epoch, so
+            # `seed` actually makes epoch order reproducible
+            np.random.seed(seed)
+        self._inner = NDArrayIter(images, labels, batch_size=batch_size, shuffle=shuffle)
+        self.batch_size = batch_size
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def getpad(self):
+        return self._inner.getpad()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class ImageRecordIter(DataIter):
+    """Images from RecordIO shards with augmentation (reference:
+    src/io/iter_image_recordio.cc + image_augmenter.h + iter_normalize.h).
+
+    Pipeline per batch: record read -> JPEG decode -> [resize-short] ->
+    [random|center crop to data_shape] -> [random mirror] -> mean/scale
+    normalize -> CHW float32 -> batch. Decoding happens on engine worker
+    threads; the next batch is produced while the current one trains
+    (PrefetcherIter semantics).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, mean_img=None, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 scale=1.0, rand_crop=False, rand_mirror=False, resize=-1,
+                 num_parts=1, part_index=0, round_batch=True, seed=0,
+                 preprocess_threads=None, prefetch_buffer=4, path_imglist=None,
+                 **_ignored):
+        super().__init__()
+        from .. import recordio as rio
+
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.scale = scale
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.round_batch = round_batch
+        self._rng = np.random.RandomState(seed)
+        self._mean = None
+        if mean_img is not None and os.path.exists(mean_img):
+            from ..ndarray import load as nd_load
+
+            self._mean = nd_load(mean_img)["mean_img"].asnumpy()
+        elif mean_r or mean_g or mean_b:
+            self._mean = np.array([mean_r, mean_g, mean_b], np.float32).reshape(3, 1, 1)
+
+        # read record offsets once; shard for this worker
+        offsets = []
+        reader = rio.MXRecordIO(path_imgrec, "r")
+        while True:
+            pos = reader.tell()
+            rec = reader.read()
+            if rec is None:
+                break
+            offsets.append(pos)
+        reader.close()
+        per = len(offsets) // num_parts
+        lo = per * part_index
+        hi = per * (part_index + 1) if part_index < num_parts - 1 else len(offsets)
+        self._offsets = offsets[lo:hi]
+        if not self._offsets:
+            raise MXNetError(f"no records in shard {part_index}/{num_parts}")
+        self._path = path_imgrec
+        self._reader = rio.MXRecordIO(path_imgrec, "r")
+        self._prefetch_depth = max(1, min(int(prefetch_buffer), 16))
+        self.reset()
+
+    def reset(self):
+        self._order = np.arange(len(self._offsets))
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self.cursor = 0
+        self._pending = []
+        self._pad = 0
+        for _ in range(self._prefetch_depth):
+            self._enqueue()
+
+    def _decode_one(self, raw, rng):
+        from .. import recordio as rio
+
+        header, img = rio.unpack_img(raw)
+        img = img.astype(np.float32)
+        c, target_h, target_w = self.data_shape
+        if self.resize > 0:
+            from PIL import Image
+
+            h, w = img.shape[:2]
+            s = self.resize / min(h, w)
+            img = np.asarray(
+                Image.fromarray(img.astype(np.uint8)).resize(
+                    (max(target_w, int(w * s)), max(target_h, int(h * s)))
+                ),
+                dtype=np.float32,
+            )
+        h, w = img.shape[:2]
+        if h < target_h or w < target_w:
+            from PIL import Image
+
+            img = np.asarray(
+                Image.fromarray(img.astype(np.uint8)).resize((target_w, target_h)),
+                dtype=np.float32,
+            )
+            h, w = img.shape[:2]
+        if self.rand_crop:
+            top = rng.randint(0, h - target_h + 1)
+            left = rng.randint(0, w - target_w + 1)
+        else:
+            top, left = (h - target_h) // 2, (w - target_w) // 2
+        img = img[top : top + target_h, left : left + target_w]
+        if self.rand_mirror and rng.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img.transpose(2, 0, 1)  # HWC -> CHW
+        if self._mean is not None:
+            img = img - (self._mean if self._mean.ndim == 3 else self._mean.reshape(3, 1, 1))
+        img = img * self.scale
+        label = header.label if header.flag > 0 else np.float32(header.label)
+        return img.astype(np.float32), label
+
+    def _enqueue(self):
+        """Schedule production of one batch on the host engine."""
+        if self.cursor >= len(self._order):
+            return
+        end = self.cursor + self.batch_size
+        idx = self._order[self.cursor : end]
+        pad = 0
+        if end > len(self._order):
+            if self.round_batch:
+                pad = end - len(self._order)
+                idx = np.concatenate([idx, self._order[:pad]])
+            else:
+                self.cursor = len(self._order)
+                return
+        self.cursor = end
+        offs = [self._offsets[i] for i in idx]
+        # each decode task gets its own RNG, seeded on the main thread, so
+        # worker-thread augmentation is race-free and reproducible
+        task_seed = int(self._rng.randint(0, 2**31 - 1))
+
+        def produce(offs=offs, pad=pad, task_seed=task_seed):
+            rng = np.random.RandomState(task_seed)
+            data = np.empty((len(offs),) + self.data_shape, np.float32)
+            labels = np.empty(
+                (len(offs),) if self.label_width == 1 else (len(offs), self.label_width),
+                np.float32,
+            )
+            from .. import recordio as rio
+
+            reader = rio.MXRecordIO(self._path, "r")
+            for i, off in enumerate(offs):
+                reader._f.seek(off)
+                raw = reader.read()
+                data[i], labels[i] = self._decode_one(raw, rng)
+            reader.close()
+            return data, labels, pad
+
+        self._pending.append(engine().push(produce))
+
+    def next(self):
+        if not self._pending:
+            raise StopIteration
+        fut = self._pending.pop(0)
+        data, labels, pad = fut.result()
+        self._enqueue()
+        self._pad = pad
+        return DataBatch([array(data)], [array(labels)], pad=pad)
+
+    def getpad(self):
+        return self._pad
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
+        return [("softmax_label", shape)]
+
+
+class CSVIter(DataIter):
+    """Batches from CSV files (reference family: dmlc data/InputSplit CSV)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, batch_size=128, **_ignored):
+        super().__init__()
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = (
+            np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            if label_csv
+            else np.zeros((data.shape[0],), np.float32)
+        )
+        self._inner = NDArrayIter(data, label, batch_size=batch_size)
+        self.batch_size = batch_size
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class PrefetchingIter(DataIter):
+    """Generic prefetch decorator running the wrapped iterator on the host
+    engine (reference: src/io/iter_prefetcher.h, <=16-deep buffer)."""
+
+    def __init__(self, iter_, depth=None):
+        super().__init__()
+        self._iter = iter_
+        self.batch_size = iter_.batch_size
+        self._depth = depth or env_int("MXNET_PREFETCH_BUFFER", 4)
+        self._queue = []
+        self._exhausted = True
+        # serialize producer tasks: the wrapped iterator is stateful, so all
+        # next() calls take a write dependency on this engine variable
+        self._var = engine().new_variable("prefetch-iter")
+
+    def reset(self):
+        # drain outstanding work before resetting the underlying iterator
+        for fut in self._queue:
+            try:
+                fut.result()
+            except StopIteration:
+                pass
+        self._queue = []
+        self._iter.reset()
+        self._exhausted = False
+        for _ in range(self._depth):
+            self._fill()
+
+    def _fill(self):
+        if self._exhausted:
+            return
+        self._queue.append(engine().push(self._iter.next, write_vars=[self._var]))
+
+    def next(self):
+        while self._queue:
+            fut = self._queue.pop(0)
+            try:
+                batch = fut.result()
+            except StopIteration:
+                self._exhausted = True
+                continue
+            self._fill()
+            return batch
+        raise StopIteration
+
+    def getpad(self):
+        return self._iter.getpad()
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label

@@ -1,0 +1,329 @@
+"""KVStore: int/str-keyed parameter synchronization for data parallelism.
+
+Reference counterpart: include/mxnet/kvstore.h + src/kvstore/* — a two-level
+parameter store ('local'/'device' in-process reduce; 'dist_sync'/'dist_async'
+over ps-lite parameter servers with BSP accumulate-until-N semantics).
+
+TPU-native redesign (SURVEY.md §2.4): the server role disappears for sync
+training. The taxonomy maps as:
+
+  'local'/'device'   -> in-process merge. Values pushed from N devices are
+                        summed on-device (XLA add chain ≙ ElementwiseSum on
+                        merge buffers); updater semantics preserved.
+  'dist_sync'        -> BSP allreduce across processes. Inside jitted train
+                        steps this is ``psum`` over the mesh's data axis (the
+                        fast path the trainer uses — see model.py/parallel);
+                        for the imperative push/pull API here it is a host
+                        collective over jax.distributed.
+  'dist_async'       -> no honest TPU equivalent (unbounded staleness is
+                        anti-idiomatic under SPMD). Accepted as an alias of
+                        dist_sync with a warning, per SURVEY.md §2.4.
+
+``create_group(n)`` builds n in-process handles sharing one server object
+with true accumulate-until-N + barrier semantics — the single-host stand-in
+for the reference's `dmlc_local.py -n N` multi-process test harness, used by
+the ported dist_sync semantics tests.
+
+Priorities are accepted and ignored: XLA's async runtime and collective
+scheduler own op ordering (reference used priorities to overlap layer-k
+gradient sync with layer-k+1 backward; XLA latency-hiding achieves this
+inside the compiled step).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import jax
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+
+__all__ = ["KVStore", "create", "create_group"]
+
+
+class KVStore:
+    """Base: single-worker store with local merge semantics."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store: dict = {}
+        self._updater = None
+
+    # -- helpers --------------------------------------------------------------
+    @staticmethod
+    def _as_pairs(key, value):
+        if isinstance(key, (int, str)):
+            return [(key, value)]
+        if len(key) != len(value):
+            raise MXNetError("key/value list length mismatch")
+        return list(zip(key, value))
+
+    @staticmethod
+    def _merge(vlist) -> NDArray:
+        """Sum a list of per-device NDArrays (reference: MergePushValue)."""
+        if isinstance(vlist, NDArray):
+            return vlist
+        total = vlist[0].data
+        for v in vlist[1:]:
+            # cross-device pushes converge onto the first value's device
+            total = total + jax.device_put(v.data, next(iter(total.devices())))
+        return NDArray(total)
+
+    # -- API ------------------------------------------------------------------
+    def init(self, key, value):
+        for k, v in self._as_pairs(key, value):
+            if k in self._store:
+                raise MXNetError(f"key {k!r} already initialized")
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        del priority  # XLA owns scheduling; accepted for parity
+        for k, vlist in self._as_pairs(key, value):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            merged = self._merge(vlist)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                merged.copyto(self._store[k])
+
+    def pull(self, key, out, priority=0):
+        del priority
+        for k, outs in self._as_pairs(key, out):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            src = self._store[k]
+            if isinstance(outs, NDArray):
+                outs = [outs]
+            for o in outs:
+                src.copyto(o)
+
+    def set_updater(self, updater):
+        """updater(key, merged_grad, stored_weight) (reference: set_updater)."""
+        self._updater = updater
+
+    # optimizer transport (reference: pickled optimizer to servers,
+    # kvstore.py:231-256; in-process there is no transport)
+    def set_optimizer(self, optimizer):
+        from .optimizer import get_updater
+
+        self.set_updater(get_updater(optimizer))
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    def barrier(self):
+        pass
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+    def __del__(self):
+        pass
+
+
+class _DeviceKVStore(KVStore):
+    """'device': merge on accelerators (reference: kvstore_device.h).
+
+    With immutable jax.Arrays the merge already happens on the device holding
+    the first pushed value, so this differs from 'local' only in name."""
+
+
+class _DistKVStore(KVStore):
+    """'dist_sync': BSP across jax.distributed processes.
+
+    push: local merge, then global sum across processes (allreduce); every
+    worker's pull then observes the same reduced value — semantically equal to
+    the reference's accumulate-until-N-at-server then broadcast
+    (kvstore_dist_server.h:164-193), minus the server hop.
+    """
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        if kv_type == "dist_async":
+            logging.warning(
+                "dist_async has no TPU-native equivalent; using BSP dist_sync "
+                "semantics (see SURVEY.md §2.4)"
+            )
+        self._nproc = jax.process_count()
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return self._nproc
+
+    def _global_sum(self, arr: NDArray) -> NDArray:
+        if self._nproc == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(arr.data)
+        return NDArray(gathered.sum(axis=0))
+
+    def push(self, key, value, priority=0):
+        del priority
+        for k, vlist in self._as_pairs(key, value):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            merged = self._global_sum(self._merge(vlist))
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                merged.copyto(self._store[k])
+
+    def barrier(self):
+        if self._nproc > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("kvstore-barrier")
+
+
+class _GroupServer:
+    """In-process BSP server for emulated multi-worker groups: accumulates
+    pushes per key until all workers arrived, runs the updater once, then
+    releases pullers (reference: KVStoreDistServer::DataHandle sync path)."""
+
+    def __init__(self, num_workers):
+        self.num_workers = num_workers
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.store: dict = {}
+        self.updater = None
+        self._accum: dict = {}
+        self._count: dict = {}
+        self._round: dict = {}
+        self._barrier_count = 0
+        self._barrier_round = 0
+
+    def init(self, key, value: np.ndarray):
+        with self.lock:
+            if key not in self.store:
+                self.store[key] = np.array(value, np.float32)
+
+    def push(self, key, value: np.ndarray):
+        with self.cv:
+            my_round = self._round.get(key, 0)
+            if key not in self._accum or self._count.get(key, 0) == 0:
+                self._accum[key] = np.array(value, np.float32)
+                self._count[key] = 1
+            else:
+                self._accum[key] += value
+                self._count[key] += 1
+            if self._count[key] == self.num_workers:
+                merged = self._accum[key]
+                if self.updater is not None:
+                    self.updater(key, merged, self.store[key])
+                else:
+                    self.store[key] = merged.copy()
+                self._count[key] = 0
+                self._round[key] = my_round + 1
+                self.cv.notify_all()
+            else:
+                self.cv.wait_for(lambda: self._round.get(key, 0) > my_round)
+
+    def pull(self, key) -> np.ndarray:
+        with self.lock:
+            return self.store[key].copy()
+
+    def barrier(self):
+        with self.cv:
+            my_round = self._barrier_round
+            self._barrier_count += 1
+            if self._barrier_count == self.num_workers:
+                self._barrier_count = 0
+                self._barrier_round += 1
+                self.cv.notify_all()
+            else:
+                self.cv.wait_for(lambda: self._barrier_round > my_round)
+
+
+class _GroupWorkerKVStore(KVStore):
+    """One worker handle of an emulated dist_sync group (use from one thread
+    per worker, like one process per worker in the reference harness)."""
+
+    def __init__(self, server: _GroupServer, rank: int):
+        super().__init__("dist_sync")
+        self._server = server
+        self._rank = rank
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._server.num_workers
+
+    def init(self, key, value):
+        for k, v in self._as_pairs(key, value):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            if self._rank == 0:  # reference: rank 0 initializes (kvstore_dist.h:49)
+                self._server.init(k, v.asnumpy())
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        del priority
+        for k, vlist in self._as_pairs(key, value):
+            merged = self._merge(vlist)
+            self._server.push(k, merged.asnumpy())
+
+    def pull(self, key, out, priority=0):
+        del priority
+        for k, outs in self._as_pairs(key, out):
+            value = self._server.pull(k)
+            if isinstance(outs, NDArray):
+                outs = [outs]
+            for o in outs:
+                NDArray(value).copyto(o)
+
+    def set_updater(self, updater):
+        """The updater runs server-side on numpy buffers, mirroring the
+        reference's run-updater-on-server contract."""
+
+        def np_updater(key, merged, stored):
+            w = NDArray(stored)
+            np_merged = NDArray(merged)
+            updater(key, np_merged, w)
+            stored[...] = w.asnumpy()
+
+        self._server.updater = np_updater
+
+    def barrier(self):
+        self._server.barrier()
+
+
+def create(kv_type="local") -> KVStore:
+    """Create a KVStore (reference: kvstore.cc:17-49 type-string factory)."""
+    kv_type = kv_type.lower()
+    if kv_type in ("local", "local_update_cpu", "local_allreduce_cpu",
+                   "local_allreduce_device"):
+        return KVStore(kv_type)
+    if kv_type in ("device",):
+        return _DeviceKVStore(kv_type)
+    if kv_type in ("dist", "dist_sync", "dist_async"):
+        return _DistKVStore("dist_sync" if kv_type == "dist" else kv_type)
+    raise MXNetError(f"unknown kvstore type {kv_type!r}")
+
+
+def create_group(num_workers: int, kv_type="dist_sync"):
+    """N worker handles sharing one BSP server (single-host stand-in for the
+    reference's `dmlc_local.py -n N` multi-process launcher; run each handle
+    from its own thread)."""
+    if kv_type not in ("dist_sync", "dist"):
+        raise MXNetError("create_group supports dist_sync semantics")
+    server = _GroupServer(num_workers)
+    return [_GroupWorkerKVStore(server, r) for r in range(num_workers)]

@@ -1,0 +1,205 @@
+"""Evaluation metrics (reference: python/mxnet/metric.py — EvalMetric,
+Accuracy, CustomMetric, ``create``). ``update`` takes (labels, preds) as
+NDArrays; readback via .asnumpy() is the per-batch sync point, exactly as in
+the reference trainer."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError, Registry
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "MAE", "MSE", "RMSE",
+           "CrossEntropy", "CustomMetric", "CompositeEvalMetric", "create", "np_metric"]
+
+METRICS = Registry("metric")
+
+
+def _to_numpy(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class EvalMetric:
+    def __init__(self, name):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        return [(name, value)]
+
+    def _as_lists(self, labels, preds):
+        if isinstance(labels, (NDArray, np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, np.ndarray)):
+            preds = [preds]
+        if len(labels) != len(preds):
+            raise MXNetError(f"{self.name}: {len(labels)} labels vs {len(preds)} preds")
+        return labels, preds
+
+
+@METRICS.register("accuracy")
+class Accuracy(EvalMetric):
+    """Classification accuracy via row-argmax (reference: metric.py:45)."""
+
+    def __init__(self):
+        super().__init__("accuracy")
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred = _to_numpy(pred)
+            label = _to_numpy(label).astype(np.int64).ravel()
+            if pred.ndim > 2:
+                pred = pred.reshape(pred.shape[0], pred.shape[1], -1)
+                hit = (pred.argmax(axis=1).ravel() == label).sum()
+                self.num_inst += label.size
+            else:
+                hit = (pred.argmax(axis=-1) == label).sum()
+                self.num_inst += label.shape[0]
+            self.sum_metric += float(hit)
+
+
+@METRICS.register("top_k_accuracy")
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=5):
+        self.top_k = top_k
+        super().__init__(f"top_{top_k}_accuracy")
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred = _to_numpy(pred)
+            label = _to_numpy(label).astype(np.int64).ravel()
+            topk = np.argsort(-pred, axis=-1)[:, : self.top_k]
+            self.sum_metric += float((topk == label[:, None]).any(axis=1).sum())
+            self.num_inst += label.shape[0]
+
+
+@METRICS.register("mae")
+class MAE(EvalMetric):
+    def __init__(self):
+        super().__init__("mae")
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _to_numpy(label), _to_numpy(pred)
+            self.sum_metric += float(np.abs(label.reshape(pred.shape) - pred).mean())
+            self.num_inst += 1
+
+
+@METRICS.register("mse")
+class MSE(EvalMetric):
+    def __init__(self):
+        super().__init__("mse")
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _to_numpy(label), _to_numpy(pred)
+            self.sum_metric += float(((label.reshape(pred.shape) - pred) ** 2).mean())
+            self.num_inst += 1
+
+
+@METRICS.register("rmse")
+class RMSE(EvalMetric):
+    def __init__(self):
+        super().__init__("rmse")
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _to_numpy(label), _to_numpy(pred)
+            self.sum_metric += float(np.sqrt(((label.reshape(pred.shape) - pred) ** 2).mean()))
+            self.num_inst += 1
+
+
+@METRICS.register("ce")
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-8):
+        self.eps = eps
+        super().__init__("cross-entropy")
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _to_numpy(label).astype(np.int64).ravel()
+            pred = _to_numpy(pred)
+            prob = pred[np.arange(label.shape[0]), label]
+            self.sum_metric += float((-np.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+class CustomMetric(EvalMetric):
+    """Wrap feval(label, pred) -> float (reference: metric.py:58)."""
+
+    def __init__(self, feval, name=None):
+        name = name or getattr(feval, "__name__", "custom")
+        if name.startswith("<"):
+            name = "custom"
+        self._feval = feval
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            self.sum_metric += float(self._feval(_to_numpy(label), _to_numpy(pred)))
+            self.num_inst += 1
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None):
+        super().__init__("composite")
+        self.metrics = list(metrics or [])
+
+    def add(self, metric):
+        self.metrics.append(metric)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+        self.num_inst = 1
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values
+
+    def get_name_value(self):
+        return [m.get() for m in self.metrics]
+
+
+def np_metric(numpy_feval):
+    """Decorator turning a numpy function into a metric (reference: mx.metric.np)."""
+    return CustomMetric(numpy_feval)
+
+
+def create(metric, **kwargs) -> EvalMetric:
+    if isinstance(metric, EvalMetric):
+        return metric
+    if callable(metric):
+        return CustomMetric(metric)
+    return METRICS.create(metric, **kwargs)

@@ -1,0 +1,452 @@
+"""FeedForward: the estimator-style trainer (reference: python/mxnet/model.py).
+
+API parity: ``FeedForward(symbol, ctx, num_epoch, optimizer, initializer,
+...)`` with ``fit / predict / score / save / load / create`` and the
+checkpoint format `prefix-symbol.json` + `prefix-%04d.params`.
+
+TPU-native execution (this is where the reference and this framework differ
+most — reference call stack in SURVEY.md §3.1):
+
+  reference: per-device GraphExecutors + engine-pushed op graph per batch +
+             kvstore push/pull per parameter + python-side SGD NDArray ops.
+  here:      ONE jitted train step per (shapes, dtype): forward + backward
+             (jax.grad) + optimizer update fused into a single XLA program
+             with donated parameter/optimizer buffers. Multi-device data
+             parallelism is a `jax.sharding.Mesh` over the given ctx list
+             with the batch sharded on the 'dp' axis — the SPMD partitioner
+             inserts the gradient psum over ICI (≙ kvstore 'device'
+             allreduce, kvstore_device.h) and overlaps it with backward
+             compute (≙ priority-ordered push/pull, model.py:319-325).
+
+  The kvstore argument keeps its reference meaning as a *strategy selector*:
+  None/'local'/'device' single-process; 'dist_sync' extends the mesh across
+  processes (multi-host). 'update_on_kvstore' semantics (weights updated
+  once, then broadcast) equal 'local' updates under BSP, so both collapse to
+  the same fused step; see SURVEY.md §2.4 hard-part #2.
+
+  Mixed precision: ``compute_dtype=jnp.bfloat16`` keeps master params in f32
+  and runs compute in bf16 (the reference is f32-only; dtype policy per
+  SURVEY.md hard-part #7).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import initializer as init_mod
+from . import io as io_mod
+from . import kvstore as kvstore_mod
+from . import metric as metric_mod
+from . import ndarray as nd
+from . import optimizer as opt_mod
+from . import random as random_mod
+from . import symbol as sym_mod
+from .base import MXNetError
+from .callback import BatchEndParam
+from .context import Context, cpu, current_context
+from .executor import _build_graph_fn
+from .ndarray import NDArray, array
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["FeedForward", "save_checkpoint", "load_checkpoint"]
+
+BASE_ESTIMATOR = object
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Write `prefix-symbol.json` + `prefix-%04d.params` (reference:
+    model.py:392-421)."""
+    symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+    logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix, epoch)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load what save_checkpoint wrote; returns (symbol, arg_params, aux_params)
+    (reference: model.py:452-461)."""
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params
+
+
+def _as_list(x):
+    return x if isinstance(x, list) else [x]
+
+
+def _init_iter(X, y, batch_size, shuffle=False, is_train=True):
+    """Coerce numpy/NDArray input into an iterator (reference: _init_iter)."""
+    if isinstance(X, io_mod.DataIter):
+        return X
+    if isinstance(X, (np.ndarray, NDArray)):
+        if is_train and y is None:
+            raise MXNetError("y is required when X is array-like")
+        return io_mod.NDArrayIter(X, y, batch_size=batch_size, shuffle=shuffle)
+    raise MXNetError(f"cannot handle input type {type(X)}")
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Reference: model.py:126-169 — resolve the kvstore strategy."""
+    if kvstore is None:
+        return None
+    if isinstance(kvstore, kvstore_mod.KVStore):
+        return kvstore
+    if isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            return None  # single device trains without any store
+        return kvstore_mod.create(kvstore)
+    raise TypeError("kvstore must be KVStore, str or None")
+
+
+class FeedForward(BASE_ESTIMATOR):
+    """Model estimator over a loss-headed Symbol (reference: model.py:465)."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, optimizer="sgd",
+                 initializer=None, arg_params=None, aux_params=None,
+                 allow_extra_params=False, begin_epoch=0,
+                 compute_dtype=None, **kwargs):
+        self.symbol = symbol
+        if ctx is None:
+            ctx = [current_context()]
+        elif isinstance(ctx, Context):
+            ctx = [ctx]
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer or init_mod.Uniform(0.01)
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.compute_dtype = compute_dtype
+        self.kwargs = dict(kwargs)
+        self._pred_fn = None
+        self._train_fns = {}
+
+    # -- parameter init -------------------------------------------------------
+    def _init_params(self, input_shapes, overwrite=False):
+        """Infer shapes and run the initializer (reference: model.py:556-569)."""
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
+        arg_names = self.symbol.list_arguments()
+        input_names = set(input_shapes.keys())
+        param_names = [n for n in arg_names if n not in input_names]
+        aux_names = self.symbol.list_auxiliary_states()
+        shape_of = dict(zip(arg_names, arg_shapes))
+        arg_params = dict(self.arg_params or {})
+        aux_params = dict(self.aux_params or {})
+        for name in param_names:
+            if name in arg_params and not overwrite:
+                continue
+            arr = nd.zeros(shape_of[name], cpu())
+            self.initializer(name, arr)
+            arg_params[name] = arr
+        for name, shape in zip(aux_names, aux_shapes):
+            if name in aux_params and not overwrite:
+                continue
+            arr = nd.zeros(shape, cpu())
+            self.initializer(name, arr)
+            aux_params[name] = arr
+        self.arg_params, self.aux_params = arg_params, aux_params
+        return param_names, aux_names
+
+    # -- device mesh ----------------------------------------------------------
+    def _make_mesh(self, dist: bool):
+        devices = [c.jax_device for c in self.ctx]
+        if dist and jax.process_count() > 1:
+            devices = jax.devices()  # span all hosts: dp over ICI+DCN
+        # de-dup while keeping order (ctx list may alias the same chip)
+        seen, devs = set(), []
+        for d in devices:
+            if d.id not in seen:
+                seen.add(d.id)
+                devs.append(d)
+        if len(devs) <= 1:
+            return None
+        return Mesh(np.array(devs), ("dp",))
+
+    # -- the fused train step -------------------------------------------------
+    def _build_train_step(self, data_names, label_names, optimizer, mesh):
+        graph_fn = _build_graph_fn(self.symbol, is_train=True)
+        compute_dtype = self.compute_dtype
+
+        def step(params, opt_state, aux, batch, rng, lr):
+            def loss_fn(p):
+                if compute_dtype is not None:
+                    p_c = {k: (v.astype(compute_dtype)
+                               if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                           for k, v in p.items()}
+                    b_c = {k: (v.astype(compute_dtype) if k in data_names else v)
+                           for k, v in batch.items()}
+                else:
+                    p_c, b_c = p, batch
+                outs, new_aux = graph_fn({**p_c, **b_c}, aux, rng)
+                # seed-ones cotangent: loss heads inject their own gradient
+                loss = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+                return loss, (outs, new_aux)
+
+            grads, (outs, new_aux) = jax.grad(loss_fn, has_aux=True)(params)
+            new_params, new_opt_state = optimizer.apply(params, grads, opt_state, lr)
+            return new_params, new_opt_state, new_aux, outs
+
+        if mesh is None:
+            return jax.jit(step, donate_argnums=(0, 1, 2))
+        repl = NamedSharding(mesh, P())
+        batch_sh = NamedSharding(mesh, P("dp"))
+        in_sh = (repl, repl, repl,
+                 {}, repl, repl)
+        # batch entries sharded on dp; replication for everything else
+        def shard_for_batch(batch):
+            return {k: batch_sh for k in batch}
+
+        jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+
+        def run(params, opt_state, aux, batch, rng, lr):
+            batch = {k: jax.device_put(v, batch_sh) for k, v in batch.items()}
+            params = jax.device_put(params, repl) if _needs_place(params, mesh) else params
+            opt_state = jax.device_put(opt_state, repl) if _needs_place(opt_state, mesh) else opt_state
+            aux = jax.device_put(aux, repl) if _needs_place(aux, mesh) else aux
+            return jitted(params, opt_state, aux, batch, rng, jnp.float32(lr))
+
+        return run
+
+    def _build_pred_step(self, mesh):
+        graph_fn = _build_graph_fn(self.symbol, is_train=False)
+        compute_dtype = self.compute_dtype
+
+        def step(params, aux, batch):
+            if compute_dtype is not None:
+                params = {k: (v.astype(compute_dtype)
+                              if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                          for k, v in params.items()}
+                batch = {k: v.astype(compute_dtype) if jnp.issubdtype(v.dtype, jnp.floating) else v
+                         for k, v in batch.items()}
+            outs, _ = graph_fn({**params, **batch}, aux, jnp.zeros((2,), jnp.uint32))
+            return tuple(o.astype(jnp.float32) for o in outs)
+
+        return jax.jit(step)
+
+    # -- fit ------------------------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="accuracy",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, batch_size=128):
+        """Train (reference: model.py:669 fit -> _train_multi_device:171).
+
+        ``work_load_list`` is accepted for parity and ignored: XLA SPMD
+        shards the batch evenly (heterogeneous device splits don't exist on a
+        TPU slice)."""
+        del work_load_list
+        if logger is None:
+            logger = logging
+        train_data = _init_iter(X, y, batch_size, shuffle=True)
+        if train_data.batch_size:
+            batch_size = train_data.batch_size
+
+        data_shapes = dict(train_data.provide_data)
+        label_shapes = dict(train_data.provide_label)
+        input_shapes = {**data_shapes, **label_shapes}
+        data_names = list(data_shapes.keys())
+        label_names = list(label_shapes.keys())
+        param_names, aux_names = self._init_params(input_shapes)
+
+        kv = _create_kvstore(kvstore, len(self.ctx), self.arg_params)
+        num_workers = kv.num_workers if kv is not None else 1
+        mesh = self._make_mesh(dist=kv is not None and "dist" in kv.type)
+
+        optimizer = self.optimizer
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(
+                optimizer,
+                rescale_grad=1.0 / (batch_size * num_workers),
+                arg_names=param_names,
+                **self.kwargs,
+            )
+        self._optimizer_obj = optimizer
+
+        # device-resident training state (f32 master params)
+        params = {k: jnp.asarray(self.arg_params[k].asnumpy()) for k in param_names}
+        aux = {k: jnp.asarray(self.aux_params[k].asnumpy()) for k in aux_names}
+        opt_state = optimizer.init_state_tree(params)
+        train_step = self._build_train_step(data_names, label_names, optimizer, mesh)
+
+        eval_metric = metric_mod.create(eval_metric)
+        num_update = 0
+        for epoch in range(self.begin_epoch, self.num_epoch or 1):
+            tic = time.time()
+            eval_metric.reset()
+            nbatch = 0
+            train_data.reset()
+            for batch in train_data:
+                batch_arrays = {}
+                for name, arr in zip(data_names, batch.data):
+                    batch_arrays[name] = arr.data
+                for name, arr in zip(label_names, batch.label):
+                    batch_arrays[name] = arr.data
+                rng = random_mod.next_key()
+                lr = optimizer._get_lr()
+                optimizer.num_update = num_update
+                params, opt_state, aux, outs = train_step(
+                    params, opt_state, aux, batch_arrays, rng, lr
+                )
+                num_update += 1
+                eval_metric.update(batch.label, [NDArray(o) for o in outs])
+                nbatch += 1
+                if batch_end_callback is not None:
+                    p = BatchEndParam(epoch=epoch, nbatch=nbatch, eval_metric=eval_metric)
+                    for cb in _as_list(batch_end_callback):
+                        cb(p)
+            name, value = eval_metric.get()
+            logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
+            logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+
+            # write state back so callbacks/checkpoints see current values
+            # (device_get: sharded -> host, so predict/save work off-mesh)
+            for k in param_names:
+                self.arg_params[k] = NDArray(np.asarray(params[k]))
+            for k in aux_names:
+                self.aux_params[k] = NDArray(np.asarray(aux[k]))
+
+            if eval_data is not None:
+                eval_metric.reset()
+                eval_iter = _init_iter(eval_data[0], eval_data[1], batch_size, is_train=False) \
+                    if isinstance(eval_data, tuple) else eval_data
+                self._eval(eval_iter, eval_metric, params, aux, data_names, label_names)
+                name, value = eval_metric.get()
+                logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
+
+            if epoch_end_callback is not None:
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, self.arg_params, self.aux_params)
+        return self
+
+    def _fill_missing_args(self, params, batch_arrays):
+        """Zero-fill label args absent at inference time (forward of loss
+        heads ignores labels; reference predict binds them as zeros too)."""
+        arg_names = self.symbol.list_arguments()
+        missing = [n for n in arg_names
+                   if n not in params and n not in batch_arrays]
+        if not missing:
+            return batch_arrays
+        known = {k: tuple(v.shape) for k, v in batch_arrays.items()}
+        known.update({k: tuple(v.shape) for k, v in params.items()
+                      if k in arg_names})
+        arg_shapes, _, _ = self.symbol.infer_shape(**known)
+        shape_of = dict(zip(arg_names, arg_shapes))
+        out = dict(batch_arrays)
+        for n in missing:
+            out[n] = jnp.zeros(shape_of[n], jnp.float32)
+        return out
+
+    def _get_pred_step(self):
+        """Cached jitted forward (rebuilding per call would recompile the
+        whole XLA program every epoch/predict)."""
+        if self._pred_fn is None:
+            self._pred_fn = self._build_pred_step(None)
+        return self._pred_fn
+
+    def _eval(self, eval_iter, eval_metric, params, aux, data_names, label_names):
+        pred = self._get_pred_step()
+        # params may be mesh-sharded during fit; pull to the default device
+        first = next(iter(params.values())) if params else None
+        if first is not None and hasattr(first, "sharding") and \
+                getattr(first.sharding, "num_devices", 1) > 1:
+            params = {k: jnp.asarray(np.asarray(v)) for k, v in params.items()}
+            aux = {k: jnp.asarray(np.asarray(v)) for k, v in aux.items()}
+        eval_iter.reset()
+        for batch in eval_iter:
+            batch_arrays = {name: arr.data for name, arr in zip(data_names, batch.data)}
+            batch_arrays = self._fill_missing_args(params, batch_arrays)
+            outs = pred(params, aux, batch_arrays)
+            pad = batch.pad
+            outs = [NDArray(o[: o.shape[0] - pad] if pad else o) for o in outs]
+            labels = [NDArray(l.data[: l.shape[0] - pad] if pad else l.data)
+                      for l in batch.label]
+            eval_metric.update(labels, outs)
+
+    # -- inference ------------------------------------------------------------
+    def predict(self, X, batch_size=128):
+        """Run forward over X, concatenating outputs (reference: model.py:640).
+
+        Returns a single numpy array for single-output nets, else a list."""
+        data_iter = _init_iter(X, None, batch_size, is_train=False)
+        data_names = [x[0] for x in data_iter.provide_data]
+        if self.arg_params is None:
+            raise MXNetError("model has no parameters; fit() or load first")
+        params = {k: v.data for k, v in self.arg_params.items()}
+        aux = {k: v.data for k, v in (self.aux_params or {}).items()}
+        pred = self._get_pred_step()
+        chunks = None
+        data_iter.reset()
+        for batch in data_iter:
+            batch_arrays = {name: arr.data for name, arr in zip(data_names, batch.data)}
+            batch_arrays = self._fill_missing_args(params, batch_arrays)
+            outs = pred(params, aux, batch_arrays)
+            pad = batch.pad
+            outs = [np.asarray(o[: o.shape[0] - pad] if pad else o) for o in outs]
+            if chunks is None:
+                chunks = [[] for _ in outs]
+            for lst, o in zip(chunks, outs):
+                lst.append(o)
+        results = [np.concatenate(lst, axis=0) for lst in chunks]
+        return results[0] if len(results) == 1 else results
+
+    def score(self, X, eval_metric="accuracy", batch_size=128):
+        """Evaluate a metric over a labeled dataset (capability extension;
+        later-MXNet surface)."""
+        data_iter = _init_iter(X, None, batch_size, is_train=False)
+        eval_metric = metric_mod.create(eval_metric)
+        params = {k: v.data for k, v in self.arg_params.items()}
+        aux = {k: v.data for k, v in (self.aux_params or {}).items()}
+        data_names = [x[0] for x in data_iter.provide_data]
+        label_names = [x[0] for x in data_iter.provide_label]
+        self._eval(data_iter, eval_metric, params, aux, data_names, label_names)
+        return eval_metric.get()[1]
+
+    # -- persistence ----------------------------------------------------------
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, optimizer="sgd",
+               initializer=None, eval_data=None, eval_metric="accuracy",
+               epoch_end_callback=None, batch_end_callback=None,
+               kvstore="local", logger=None, batch_size=128, **kwargs):
+        """Train a new model from data (reference: model.py:820-878)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            optimizer=optimizer, initializer=initializer or
+                            init_mod.Uniform(0.01), **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, batch_size=batch_size)
+        return model
+
+
+def _needs_place(tree, mesh):
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return False
+    first = leaves[0]
+    return not (hasattr(first, "sharding") and
+                getattr(first.sharding, "mesh", None) is mesh)

@@ -1,0 +1,16 @@
+"""Model zoo (reference: example/ — mnist MLP/LeNet, cifar10 Inception-BN,
+imagenet AlexNet/Inception-BN, rnn unrolled LSTM), plus the modern TPU
+flagships (ResNet-50 for the north-star benchmark, a transformer LM for
+tensor/sequence-parallel training)."""
+
+from .mlp import mlp
+from .lenet import lenet
+from .alexnet import alexnet
+from .inception import inception_bn_cifar, inception_bn
+from .resnet import resnet, resnet50
+from .lstm import lstm_unroll, LSTMState, LSTMParam
+from .transformer import TransformerLM, transformer_lm_config
+
+__all__ = ["mlp", "lenet", "alexnet", "inception_bn_cifar", "inception_bn",
+           "resnet", "resnet50", "lstm_unroll", "LSTMState", "LSTMParam",
+           "TransformerLM", "transformer_lm_config"]

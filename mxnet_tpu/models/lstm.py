@@ -1,0 +1,94 @@
+"""Unrolled LSTM language model (reference: example/rnn/lstm.py:43-99 —
+seq_len x num_layers cells with shared weight symbols, per-step data/label
+variables, grouped outputs).
+
+The unrolled Symbol keeps API parity (and exercises weight sharing +
+SliceChannel); the *fast path* on TPU is the scan-based step in
+``models.transformer``-style pure functions — XLA compiles ``lax.scan`` once
+instead of seq_len copies of the cell (SURVEY.md §7 stage 7).
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+from .. import symbol as sym
+
+LSTMState = namedtuple("LSTMState", ["c", "h"])
+LSTMParam = namedtuple("LSTMParam", ["i2h_weight", "i2h_bias",
+                                     "h2h_weight", "h2h_bias"])
+
+
+def _lstm_cell(num_hidden, indata, prev_state, param, seqidx, layeridx):
+    """One LSTM cell built from shared weight symbols (reference lstm.py:43)."""
+    i2h = sym.FullyConnected(data=indata, weight=param.i2h_weight,
+                             bias=param.i2h_bias, num_hidden=num_hidden * 4,
+                             name=f"t{seqidx}_l{layeridx}_i2h")
+    h2h = sym.FullyConnected(data=prev_state.h, weight=param.h2h_weight,
+                             bias=param.h2h_bias, num_hidden=num_hidden * 4,
+                             name=f"t{seqidx}_l{layeridx}_h2h")
+    gates = i2h + h2h
+    slice_gates = sym.SliceChannel(gates, num_outputs=4,
+                                   name=f"t{seqidx}_l{layeridx}_slice")
+    in_gate = sym.Activation(slice_gates[0], act_type="sigmoid")
+    in_transform = sym.Activation(slice_gates[1], act_type="tanh")
+    forget_gate = sym.Activation(slice_gates[2], act_type="sigmoid")
+    out_gate = sym.Activation(slice_gates[3], act_type="sigmoid")
+    next_c = (forget_gate * prev_state.c) + (in_gate * in_transform)
+    next_h = out_gate * sym.Activation(next_c, act_type="tanh")
+    return LSTMState(c=next_c, h=next_h)
+
+
+def lstm_unroll(num_layers, seq_len, input_size, num_hidden, num_embed,
+                num_label, dropout=0.0):
+    """Build the fully-unrolled training graph (reference: lstm_unroll).
+
+    Inputs: per-step ``t{i}_data`` (token ids) and ``t{i}_label``; outputs:
+    grouped per-step SoftmaxOutputs plus BlockGrad-wrapped final states."""
+    embed_weight = sym.Variable("embed_weight")
+    cls_weight = sym.Variable("cls_weight")
+    cls_bias = sym.Variable("cls_bias")
+    param_cells = []
+    last_states = []
+    for i in range(num_layers):
+        param_cells.append(LSTMParam(
+            i2h_weight=sym.Variable(f"l{i}_i2h_weight"),
+            i2h_bias=sym.Variable(f"l{i}_i2h_bias"),
+            h2h_weight=sym.Variable(f"l{i}_h2h_weight"),
+            h2h_bias=sym.Variable(f"l{i}_h2h_bias"),
+        ))
+        last_states.append(LSTMState(
+            c=sym.Variable(f"l{i}_init_c"), h=sym.Variable(f"l{i}_init_h")
+        ))
+
+    out_prob = []
+    for seqidx in range(seq_len):
+        data = sym.Variable(f"t{seqidx}_data")
+        hidden = sym.Embedding(data=data, weight=embed_weight,
+                               input_dim=input_size, output_dim=num_embed,
+                               name=f"t{seqidx}_embed")
+        for i in range(num_layers):
+            next_state = _lstm_cell(num_hidden, indata=hidden,
+                                    prev_state=last_states[i],
+                                    param=param_cells[i],
+                                    seqidx=seqidx, layeridx=i)
+            hidden = next_state.h
+            last_states[i] = next_state
+            if dropout > 0.0:
+                hidden = sym.Dropout(data=hidden, p=dropout)
+        fc = sym.FullyConnected(data=hidden, weight=cls_weight, bias=cls_bias,
+                                num_hidden=num_label,
+                                name=f"t{seqidx}_cls")
+        label = sym.Variable(f"t{seqidx}_label")
+        sm = sym.SoftmaxOutput(data=fc, label=label, name=f"t{seqidx}_sm")
+        out_prob.append(sm)
+
+    for i in range(num_layers):
+        state = last_states[i]
+        state = LSTMState(c=sym.BlockGrad(state.c, name=f"l{i}_last_c"),
+                          h=sym.BlockGrad(state.h, name=f"l{i}_last_h"))
+        last_states[i] = state
+
+    unpack_c = [state.c for state in last_states]
+    unpack_h = [state.h for state in last_states]
+    return sym.Group(out_prob + unpack_c + unpack_h)

@@ -1,0 +1,13 @@
+"""3-layer MLP (reference: example/mnist/mlp.py)."""
+
+from .. import symbol as sym
+
+
+def mlp(num_classes=10, hidden=(128, 64)):
+    data = sym.Variable("data")
+    net = data
+    for i, h in enumerate(hidden):
+        net = sym.FullyConnected(data=net, name=f"fc{i + 1}", num_hidden=h)
+        net = sym.Activation(data=net, name=f"relu{i + 1}", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc3", num_hidden=num_classes)
+    return sym.SoftmaxOutput(data=net, name="softmax")

@@ -1,0 +1,213 @@
+"""Transformer language model — the multi-chip flagship.
+
+This is the model that exercises the full TPU-native parallel stack
+(capabilities the reference lacks, SURVEY.md §5): a decoder-only LM whose
+training step shards over a (dp, tp, sp) mesh —
+
+  dp: batch sharding, gradient psum inserted by the SPMD partitioner
+  tp: Megatron-style column/row parallel matmuls (parallel.tensor_parallel)
+  sp: ring attention over the sequence axis (parallel.sequence)
+
+Pure-functional: params are a flat dict (names match
+``parallel.transformer_param_specs``), forward/loss are jit-traceable, and
+``make_train_step`` returns a donated, sharded, fused step.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.sequence import attention_reference, ring_self_attention
+from ..parallel.tensor_parallel import transformer_param_specs
+
+__all__ = ["transformer_lm_config", "TransformerLM"]
+
+
+def transformer_lm_config(vocab_size=32000, d_model=512, n_heads=8, n_layers=4,
+                          d_ff=None, max_len=2048, dtype=jnp.bfloat16):
+    return {
+        "vocab_size": vocab_size,
+        "d_model": d_model,
+        "n_heads": n_heads,
+        "n_layers": n_layers,
+        "d_ff": d_ff or 4 * d_model,
+        "max_len": max_len,
+        "dtype": dtype,
+    }
+
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+class TransformerLM:
+    def __init__(self, config):
+        self.cfg = dict(config)
+
+    # -- parameters -----------------------------------------------------------
+    def init_params(self, key) -> dict:
+        cfg = self.cfg
+        d, ff, v = cfg["d_model"], cfg["d_ff"], cfg["vocab_size"]
+        n = cfg["n_layers"]
+        keys = jax.random.split(key, 4 + 4 * n)
+        ki = iter(keys)
+
+        def dense(key, shape, scale=None):
+            scale = scale or 1.0 / math.sqrt(shape[0])
+            return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+        params = {
+            "embed": dense(next(ki), (v, d), scale=0.02),
+            "pos_embed": dense(next(ki), (cfg["max_len"], d), scale=0.02),
+            "final_norm_scale": jnp.ones((d,), jnp.float32),
+            "final_norm_bias": jnp.zeros((d,), jnp.float32),
+            "lm_head": dense(next(ki), (d, v)),
+        }
+        for i in range(n):
+            params.update({
+                f"layer{i}_wqkv": dense(next(ki), (d, 3 * d)),
+                f"layer{i}_wo": dense(next(ki), (d, d)),
+                f"layer{i}_w1": dense(next(ki), (d, ff)),
+                f"layer{i}_b1": jnp.zeros((ff,), jnp.float32),
+                f"layer{i}_w2": dense(next(ki), (ff, d)),
+                f"layer{i}_b2": jnp.zeros((d,), jnp.float32),
+                f"layer{i}_ln1_scale": jnp.ones((d,), jnp.float32),
+                f"layer{i}_ln1_bias": jnp.zeros((d,), jnp.float32),
+                f"layer{i}_ln2_scale": jnp.ones((d,), jnp.float32),
+                f"layer{i}_ln2_bias": jnp.zeros((d,), jnp.float32),
+            })
+        return params
+
+    def param_shardings(self, mesh: Mesh) -> dict:
+        specs = transformer_param_specs(self.cfg["n_layers"])
+        return {k: NamedSharding(mesh, specs.get(k, P())) for k in self.init_shapes()}
+
+    def init_shapes(self):
+        cfg = self.cfg
+        d, ff, v = cfg["d_model"], cfg["d_ff"], cfg["vocab_size"]
+        shapes = {"embed": (v, d), "pos_embed": (cfg["max_len"], d),
+                  "final_norm_scale": (d,), "final_norm_bias": (d,),
+                  "lm_head": (d, v)}
+        for i in range(cfg["n_layers"]):
+            shapes.update({
+                f"layer{i}_wqkv": (d, 3 * d), f"layer{i}_wo": (d, d),
+                f"layer{i}_w1": (d, ff), f"layer{i}_b1": (ff,),
+                f"layer{i}_w2": (ff, d), f"layer{i}_b2": (d,),
+                f"layer{i}_ln1_scale": (d,), f"layer{i}_ln1_bias": (d,),
+                f"layer{i}_ln2_scale": (d,), f"layer{i}_ln2_bias": (d,),
+            })
+        return shapes
+
+    # -- forward --------------------------------------------------------------
+    def forward(self, params, tokens, mesh: Mesh | None = None):
+        """tokens [batch, seq] int32 -> logits [batch, seq, vocab] f32.
+
+        With a mesh, activations carry (dp, sp, tp) sharding constraints and
+        attention runs as ring attention when the sp axis is >1."""
+        cfg = self.cfg
+        dtype = cfg["dtype"]
+        d, h = cfg["d_model"], cfg["n_heads"]
+        hd = d // h
+        use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
+
+        def cst(x, spec):
+            if mesh is None:
+                return x
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+        seq = tokens.shape[1]
+        x = jnp.take(params["embed"], tokens, axis=0).astype(dtype)
+        x = x * jnp.asarray(math.sqrt(d), dtype)
+        x = x + params["pos_embed"][:seq].astype(dtype)
+        x = cst(x, P("dp", "sp", None))
+
+        for i in range(cfg["n_layers"]):
+            # attention block
+            y = _layernorm(x, params[f"layer{i}_ln1_scale"],
+                           params[f"layer{i}_ln1_bias"])
+            qkv = jnp.einsum("bsd,df->bsf", y, params[f"layer{i}_wqkv"].astype(dtype),
+                             preferred_element_type=jnp.float32).astype(dtype)
+            qkv = qkv.reshape(qkv.shape[0], seq, 3, h, hd)
+            q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+            q = cst(q, P("dp", "tp", "sp", None))
+            k = cst(k, P("dp", "tp", "sp", None))
+            v = cst(v, P("dp", "tp", "sp", None))
+            if use_sp:
+                attn = ring_self_attention(mesh, q, k, v, causal=True)
+            else:
+                attn = attention_reference(q, k, v, causal=True)
+            attn = attn.transpose(0, 2, 1, 3).reshape(x.shape[0], seq, d)
+            attn = jnp.einsum("bsd,df->bsf", attn, params[f"layer{i}_wo"].astype(dtype),
+                              preferred_element_type=jnp.float32).astype(dtype)
+            x = cst(x + attn, P("dp", "sp", None))
+
+            # mlp block (column-parallel w1, row-parallel w2)
+            y = _layernorm(x, params[f"layer{i}_ln2_scale"],
+                           params[f"layer{i}_ln2_bias"])
+            u = jnp.einsum("bsd,df->bsf", y, params[f"layer{i}_w1"].astype(dtype),
+                           preferred_element_type=jnp.float32).astype(dtype)
+            u = u + params[f"layer{i}_b1"].astype(dtype)
+            u = cst(u, P("dp", "sp", "tp"))
+            u = jax.nn.gelu(u)
+            z = jnp.einsum("bsf,fd->bsd", u, params[f"layer{i}_w2"].astype(dtype),
+                           preferred_element_type=jnp.float32).astype(dtype)
+            z = z + params[f"layer{i}_b2"].astype(dtype)
+            x = cst(x + z, P("dp", "sp", None))
+
+        x = _layernorm(x, params["final_norm_scale"], params["final_norm_bias"])
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(dtype),
+                            preferred_element_type=jnp.float32)
+        return cst(logits.astype(jnp.float32), P("dp", "sp", None))
+
+    def loss(self, params, tokens, targets, mesh=None):
+        logits = self.forward(params, tokens, mesh=mesh)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
+                                   axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    # -- fused, sharded train step --------------------------------------------
+    def make_train_step(self, mesh: Mesh | None, lr=1e-3):
+        """SGD-with-momentum train step, donated and sharded over the mesh."""
+
+        def step(params, moms, tokens, targets):
+            loss, grads = jax.value_and_grad(
+                lambda p: self.loss(p, tokens, targets, mesh=mesh)
+            )(params)
+            new_moms = {k: 0.9 * moms[k] + grads[k] for k in params}
+            new_params = {k: params[k] - lr * new_moms[k] for k in params}
+            return new_params, new_moms, loss
+
+        if mesh is None:
+            return jax.jit(step, donate_argnums=(0, 1))
+        pshard = self.param_shardings(mesh)
+        dshard = NamedSharding(mesh, P("dp", "sp"))
+        return jax.jit(
+            step,
+            in_shardings=(pshard, pshard, dshard, dshard),
+            out_shardings=(pshard, pshard, NamedSharding(mesh, P())),
+            donate_argnums=(0, 1),
+        )
+
+    def init_sharded(self, mesh: Mesh | None, seed=0):
+        """Initialize params (and momentum buffers) directly with their target
+        shardings, so no single host materializes the full model."""
+        params = self.init_params(jax.random.PRNGKey(seed))
+        if mesh is None:
+            moms = {k: jnp.zeros_like(v) for k, v in params.items()}
+            return params, moms
+        sh = self.param_shardings(mesh)
+        params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        moms = {k: jax.device_put(jnp.zeros_like(v), sh[k])
+                for k, v in params.items()}
+        return params, moms

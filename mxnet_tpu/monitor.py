@@ -1,0 +1,66 @@
+"""Monitor: inspect internal layer outputs/weights during training.
+
+Reference counterpart: python/mxnet/monitor.py (installs an output callback on
+every executor op). Under XLA the forward is one fused program, so internals
+are not observable in-flight; the Monitor instead re-runs the bound symbol's
+``get_internals()`` graph on demand — same information, one extra (jitted,
+cached) forward when stats are collected. This keeps the reference's
+tic()/toc()/toc_print() workflow."""
+
+from __future__ import annotations
+
+import logging
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from .executor import _build_graph_fn
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*"):
+        self.interval = interval
+        self.stat_func = stat_func or (lambda x: np.abs(x).mean())
+        self.pattern = re.compile(pattern)
+        self.step = 0
+        self.activated = False
+        self.queue = []
+        self._exe = None
+
+    def install(self, exe):
+        """Attach to an Executor (reference: Monitor.install)."""
+        self._exe = exe
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+        self.step += 1
+
+    def toc(self):
+        if not self.activated or self._exe is None:
+            return []
+        self.activated = False
+        exe = self._exe
+        internals = exe._symbol.get_internals()
+        fn = _build_graph_fn(internals, is_train=False)
+        args = {n: a._data for n, a in exe.arg_dict.items()}
+        aux = {n: a._data for n, a in exe.aux_dict.items()}
+        outs, _ = fn(args, aux, jnp.zeros((2,), jnp.uint32))
+        res = []
+        for name, value in zip(internals.list_outputs(), outs):
+            if self.pattern.match(name):
+                res.append((self.step, name, self.stat_func(np.asarray(value))))
+        for name, arr in exe.arg_dict.items():
+            if self.pattern.match(name):
+                res.append((self.step, name, self.stat_func(arr.asnumpy())))
+        self.queue = res
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, stat)

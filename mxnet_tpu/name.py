@@ -1,0 +1,49 @@
+"""Automatic symbol naming (reference: python/mxnet/name.py NameManager)."""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_tls = threading.local()
+
+
+class NameManager:
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        hint = hint.lower().lstrip("_")
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        self._old = current()
+        _tls.current = self
+        return self
+
+    def __exit__(self, *exc):
+        _tls.current = self._old
+        return False
+
+
+class Prefix(NameManager):
+    """Prepends a prefix to every auto-generated name."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return name if name else self._prefix + super().get(name, hint)
+
+
+def current() -> NameManager:
+    if not hasattr(_tls, "current"):
+        _tls.current = NameManager()
+    return _tls.current

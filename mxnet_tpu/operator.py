@@ -1,0 +1,51 @@
+"""Custom operators in Python/numpy (reference: python/mxnet/operator.py
+NumpyOp — bridged into graphs through the `_Native` op; the reference passes
+C function pointers through the FFI (operator.py:103-112), here the live
+object rides inside the OpProp and executes via jax.pure_callback)."""
+
+from __future__ import annotations
+
+from . import symbol as sym_mod
+from .ops.registry import OPS
+
+__all__ = ["NumpyOp"]
+
+
+class NumpyOp:
+    """Base class for user ops written with numpy.
+
+    Subclass and override forward/backward/list_arguments/list_outputs/
+    infer_shape; then call the instance like a symbol constructor:
+
+        class MySoftmax(NumpyOp):
+            def forward(self, in_data, out_data): ...
+            def backward(self, out_grad, in_data, out_data, in_grad): ...
+
+        op = MySoftmax()
+        net = op(data=prev_sym, name='softmax')
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad = need_top_grad
+
+    # -- user-overridable protocol (reference signatures) ---------------------
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def __call__(self, *args, name=None, **kwargs):
+        return sym_mod._create(
+            "_Native", *args, name=name,
+            info=self, need_top_grad=self.need_top_grad, **kwargs
+        )

@@ -1,0 +1,164 @@
+"""Loss-head operators with reference-faithful injected gradients.
+
+Reference counterparts: src/operator/softmax_output-inl.h and
+regression_output-inl.h. In the reference these ops' Backward does NOT
+compute the derivative of their forward output — it injects the loss
+gradient directly (softmax-cross-entropy: p - onehot(label); regression:
+pred - label) and ignores any incoming out_grad. We reproduce that contract
+with ``jax.custom_vjp`` whose backward rule discards the cotangent, so
+``Executor.backward()`` (which seeds ones) and ``jax.grad`` of a sum over
+outputs both yield byte-identical gradients to the reference semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import OpProp, register_op
+
+
+def _softmax(x, axis):
+    return jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _softmax_output(data, label, grad_scale, multi_output):
+    axis = 1 if (multi_output or data.ndim > 2) else -1
+    return _softmax(data, axis)
+
+
+def _softmax_output_fwd(data, label, grad_scale, multi_output):
+    out = _softmax_output(data, label, grad_scale, multi_output)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, multi_output, res, g):
+    del g  # reference semantics: out_grad to a loss head is ignored
+    out, label = res
+    axis = 1 if (multi_output or out.ndim > 2) else -1
+    num_classes = out.shape[axis]
+    onehot = jax.nn.one_hot(
+        label.astype(jnp.int32), num_classes, axis=axis, dtype=jnp.float32
+    )
+    d_data = (out.astype(jnp.float32) - onehot) * grad_scale
+    return d_data.astype(out.dtype), jnp.zeros_like(label)
+
+
+_softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register_op("SoftmaxOutput", aliases=["Softmax"])
+class SoftmaxOutputOp(OpProp):
+    """Softmax forward + cross-entropy gradient injection (reference:
+    softmax_output.cc:22-27; the bare ``Softmax`` name is the deprecated
+    alias the reference keeps)."""
+
+    params = {
+        "grad_scale": (float, 1.0, "multiplier applied to the injected gradient"),
+        "multi_output": (bool, False, "softmax over axis 1 with per-position labels"),
+    }
+    is_loss = True
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        if self.multi_output or len(d) > 2:
+            label = (d[0],) + tuple(d[2:])
+        else:
+            label = (d[0],)
+        return [d, label], [d], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        return [_softmax_output(ins[0], ins[1], self.grad_scale, self.multi_output)], []
+
+
+def _regression_vjp(transform, grad_fn):
+    @jax.custom_vjp
+    def op(data, label):
+        return transform(data)
+
+    def fwd(data, label):
+        out = transform(data)
+        return out, (out, label)
+
+    def bwd(res, g):
+        del g
+        out, label = res
+        d = grad_fn(out.astype(jnp.float32), label.astype(jnp.float32).reshape(out.shape))
+        return d.astype(out.dtype), jnp.zeros_like(label)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+_linear_regression = _regression_vjp(lambda x: x, lambda o, l: o - l)
+_logistic_regression = _regression_vjp(jax.nn.sigmoid, lambda o, l: o - l)
+_mae_regression = _regression_vjp(lambda x: x, lambda o, l: jnp.sign(o - l))
+
+
+class _RegressionBase(OpProp):
+    params = {"grad_scale": (float, 1.0, "gradient multiplier")}
+    is_loss = True
+    _kernel = None
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        return [d, d], [d], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        out = type(self)._kernel(ins[0], ins[1])
+        if self.grad_scale != 1.0:
+            # fold the scale into the custom vjp via linearity of the grad
+            out = _ScaleGrad(self.grad_scale)(out)
+        return [out], []
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _scale_grad(scale, x):
+    return x
+
+
+_scale_grad.defvjp(
+    lambda scale, x: (x, None),
+    lambda scale, res, g: (g * scale,),
+)
+
+
+class _ScaleGrad:
+    def __init__(self, scale):
+        self.scale = scale
+
+    def __call__(self, x):
+        return _scale_grad(self.scale, x)
+
+
+@register_op("LinearRegressionOutput")
+class LinearRegressionOutputOp(_RegressionBase):
+    """Identity forward, (pred - label) gradient (reference:
+    regression_output.cc:31)."""
+
+    _kernel = staticmethod(_linear_regression)
+
+
+@register_op("LogisticRegressionOutput")
+class LogisticRegressionOutputOp(_RegressionBase):
+    """Sigmoid forward, (pred - label) gradient (reference:
+    regression_output.cc:36)."""
+
+    _kernel = staticmethod(_logistic_regression)
+
+
+@register_op("MAERegressionOutput")
+class MAERegressionOutputOp(_RegressionBase):
+    """Identity forward, sign(pred - label) gradient (L1 regression head;
+    capability extension in the same family)."""
+
+    _kernel = staticmethod(_mae_regression)

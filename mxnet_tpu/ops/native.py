@@ -1,0 +1,106 @@
+"""The `_Native` bridge: user-defined numpy operators inside compiled graphs.
+
+Reference counterpart: src/operator/native_op-inl.h + python/mxnet/operator.py
+(NumpyOp), where a Python object's function pointers are smuggled through the
+C API as integers. TPU-native: ``jax.pure_callback`` hosts the numpy forward
+inside the traced/compiled graph, and a ``jax.custom_vjp`` routes autodiff to
+the user's numpy ``backward`` — so custom numpy ops compose with jit, grad and
+sharding (callbacks run host-side per shard).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import OpProp, register_op
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _native_apply(op, *ins):
+    return _run_forward(op, ins)
+
+
+def _run_forward(op, ins):
+    in_shapes = [tuple(x.shape) for x in ins]
+    _, out_shapes = op.infer_shape(in_shapes)
+    result_shapes = [jax.ShapeDtypeStruct(s, jnp.float32) for s in out_shapes]
+
+    def _cb(*arrays):
+        in_data = [np.asarray(a, dtype=np.float32) for a in arrays]
+        out_data = [np.zeros(s, dtype=np.float32) for s in out_shapes]
+        op.forward(in_data=in_data, out_data=out_data)
+        return tuple(out_data)
+
+    outs = jax.pure_callback(_cb, tuple(result_shapes), *ins)
+    return tuple(outs)
+
+
+def _native_fwd(op, *ins):
+    outs = _run_forward(op, ins)
+    return outs, (ins, outs)
+
+
+def _native_bwd(op, res, gs):
+    ins, outs = res
+    in_shapes = [tuple(x.shape) for x in ins]
+    result_shapes = [jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes]
+
+    def _cb(*arrays):
+        n_in = len(in_shapes)
+        in_data = [np.asarray(a, np.float32) for a in arrays[:n_in]]
+        n_out = len(arrays[1:]) // 2
+        out_data = [np.asarray(a, np.float32) for a in arrays[n_in : n_in + n_out]]
+        out_grad = [np.asarray(a, np.float32) for a in arrays[n_in + n_out :]]
+        in_grad = [np.zeros(s, np.float32) for s in in_shapes]
+        op.backward(
+            out_grad=out_grad, in_data=in_data, out_data=out_data, in_grad=in_grad
+        )
+        return tuple(in_grad)
+
+    grads = jax.pure_callback(_cb, tuple(result_shapes), *ins, *outs, *gs)
+    return tuple(grads)
+
+
+_native_apply.defvjp(_native_fwd, _native_bwd)
+
+
+@register_op("_Native")
+class NativeOp(OpProp):
+    """Wraps a python object implementing the NumpyOp protocol
+    (forward/backward/list_arguments/list_outputs/infer_shape)."""
+
+    params = {
+        "info": ((lambda v: v), None, "the python NumpyOp instance"),
+        "need_top_grad": (bool, True, "whether backward consumes out_grad"),
+    }
+
+    def _op(self):
+        op = self.attr["info"]
+        if op is None:
+            raise MXNetError("_Native op requires info= (a NumpyOp instance)")
+        return op
+
+    def list_arguments(self):
+        return list(self._op().list_arguments())
+
+    def list_outputs(self):
+        return list(self._op().list_outputs())
+
+    def infer_shape(self, in_shapes):
+        known = [tuple(s) if s is not None else None for s in in_shapes]
+        if any(s is None for s in known):
+            raise MXNetError("_Native: all input shapes must be known")
+        ins, outs = self._op().infer_shape(known)
+        return [tuple(s) for s in ins], [tuple(s) for s in outs], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        outs = _native_apply(self._op(), *[x.astype(jnp.float32) for x in ins])
+        return list(outs), []
+
+    def serialize_params(self):
+        raise MXNetError("_Native ops hold live python objects and cannot be serialized")

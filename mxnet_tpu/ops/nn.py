@@ -1,0 +1,394 @@
+"""Neural-network layer operators.
+
+Reference counterparts under src/operator/: fully_connected-inl.h,
+convolution-inl.h, deconvolution-inl.h, pooling-inl.h, batch_norm-inl.h,
+dropout-inl.h, lrn-inl.h, activation-inl.h, leaky_relu-inl.h.
+
+TPU-native design notes:
+  - Convolution lowers to ``lax.conv_general_dilated`` in NCHW/OIHW — XLA:TPU
+    retiles this onto the MXU; the reference's im2col + grouped GEMM +
+    workspace chunking (convolution-inl.h:68-140) is exactly what the compiler
+    does better, so none of it is reimplemented.
+  - Pooling is ``lax.reduce_window``; LRN is a windowed mean over channels.
+  - BatchNorm carries aux state (moving_mean/moving_var, batch_norm-inl.h:88)
+    functionally: fwd returns updated aux, the executor writes it back.
+  - Dropout/RReLU consume an explicit PRNG key (replacing the engine-managed
+    kRandom resource, include/mxnet/resource.h).
+  - Compute dtype follows the input dtype; params may be float32 while
+    activations are bfloat16 (mixed precision is handled at the model layer).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import OpProp, REQUIRED, TupleParam, register_op
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+@register_op("FullyConnected")
+class FullyConnectedOp(OpProp):
+    """Affine layer: Y = X·Wᵀ + b (reference: fully_connected-inl.h:53-118).
+
+    Weight layout (num_hidden, input_dim) matches the reference so checkpoints
+    map 1:1. The matmul contracts in the input dtype and accumulates f32 on
+    the MXU (preferred_element_type)."""
+
+    params = {
+        "num_hidden": (int, REQUIRED, "number of output units"),
+        "no_bias": (bool, False, "omit the bias term"),
+    }
+
+    def list_arguments(self):
+        return ["data", "weight"] if self.no_bias else ["data", "weight", "bias"]
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        in_dim = 1
+        for x in d[1:]:
+            in_dim *= x
+        shapes = [d, (self.num_hidden, in_dim)]
+        if not self.no_bias:
+            shapes.append((self.num_hidden,))
+        return shapes, [(d[0], self.num_hidden)], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        x = ins[0]
+        x = x.reshape((x.shape[0], -1))
+        w = ins[1].astype(x.dtype)
+        y = lax.dot_general(
+            x, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        if not self.no_bias:
+            y = y + ins[2].astype(x.dtype)
+        return [y], []
+
+
+@register_op("Convolution")
+class ConvolutionOp(OpProp):
+    """2-D convolution, NCHW/OIHW (reference: convolution-inl.h)."""
+
+    params = {
+        "kernel": (TupleParam(2), REQUIRED, "kernel (h, w)"),
+        "stride": (TupleParam(2), (1, 1), "stride (h, w)"),
+        "pad": (TupleParam(2), (0, 0), "zero-padding (h, w)"),
+        "dilate": (TupleParam(2), (1, 1), "dilation (h, w) (extension)"),
+        "num_filter": (int, REQUIRED, "number of output channels"),
+        "num_group": (int, 1, "grouped-convolution group count"),
+        "no_bias": (bool, False, "omit the bias term"),
+        "workspace": (int, 512, "accepted for parity; XLA manages scratch"),
+    }
+
+    def list_arguments(self):
+        return ["data", "weight"] if self.no_bias else ["data", "weight", "bias"]
+
+    def _out_hw(self, h, w):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        dh, dw = self.dilate
+        eh, ew = dh * (kh - 1) + 1, dw * (kw - 1) + 1
+        return (h + 2 * ph - eh) // sh + 1, (w + 2 * pw - ew) // sw + 1
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        if len(d) != 4:
+            raise MXNetError(f"Convolution expects NCHW input, got {d}")
+        n, c, h, w = d
+        if c % self.num_group or self.num_filter % self.num_group:
+            raise MXNetError("Convolution: channels not divisible by num_group")
+        wshape = (self.num_filter, c // self.num_group) + self.kernel
+        oh, ow = self._out_hw(h, w)
+        shapes = [d, wshape] + ([] if self.no_bias else [(self.num_filter,)])
+        return shapes, [(n, self.num_filter, oh, ow)], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        x = ins[0]
+        w = ins[1].astype(x.dtype)
+        # no preferred_element_type: its transpose rule mixes dtypes under
+        # bf16 autodiff; TPU convs accumulate f32 for bf16 inputs regardless
+        y = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=self.stride,
+            padding=[(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])],
+            rhs_dilation=self.dilate,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.num_group,
+        )
+        if not self.no_bias:
+            y = y + ins[2].astype(x.dtype).reshape((1, -1, 1, 1))
+        return [y], []
+
+
+@register_op("Deconvolution")
+class DeconvolutionOp(OpProp):
+    """Transposed convolution (reference: deconvolution-inl.h), implemented as
+    input-dilated convolution with a spatially-flipped kernel — the native XLA
+    formulation of conv-transpose."""
+
+    params = {
+        "kernel": (TupleParam(2), REQUIRED, "kernel (h, w)"),
+        "stride": (TupleParam(2), (1, 1), "stride (h, w)"),
+        "pad": (TupleParam(2), (0, 0), "padding (h, w)"),
+        "num_filter": (int, REQUIRED, "number of output channels"),
+        "num_group": (int, 1, "group count"),
+        "no_bias": (bool, True, "omit the bias term"),
+        "workspace": (int, 512, "accepted for parity"),
+    }
+
+    def list_arguments(self):
+        return ["data", "weight"] if self.no_bias else ["data", "weight", "bias"]
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        n, c, h, w = d
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        oh = sh * (h - 1) + kh - 2 * ph
+        ow = sw * (w - 1) + kw - 2 * pw
+        wshape = (c, self.num_filter // self.num_group) + self.kernel
+        shapes = [d, wshape] + ([] if self.no_bias else [(self.num_filter,)])
+        return shapes, [(n, self.num_filter, oh, ow)], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        x = ins[0]
+        w = ins[1].astype(x.dtype)
+        kh, kw = self.kernel
+        ph, pw = self.pad
+        g = self.num_group
+        # weight (c, f/g, kh, kw) -> OIHW (f, c/g, kh, kw) per group, flipped
+        # spatially; lhs_dilation realizes the stride.
+        w = jnp.flip(w, axis=(-2, -1))
+        c = w.shape[0]
+        if g > 1:
+            w = w.reshape(g, c // g, -1, kh, kw).transpose((0, 2, 1, 3, 4))
+            w_t = w.reshape(-1, c // g, kh, kw)
+        else:
+            w_t = w.transpose((1, 0, 2, 3))
+        y = lax.conv_general_dilated(
+            x,
+            w_t,
+            window_strides=(1, 1),
+            padding=[(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)],
+            lhs_dilation=self.stride,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.num_group,
+        )
+        if not self.no_bias:
+            y = y + ins[2].astype(x.dtype).reshape((1, -1, 1, 1))
+        return [y], []
+
+
+@register_op("Pooling")
+class PoolingOp(OpProp):
+    """Max/avg/sum pooling over NCHW (reference: pooling-inl.h).
+
+    Matches the reference's ceil-mode output arithmetic
+    ((x + 2p - k) / s + 1 rounded up when it doesn't divide; mshadow pool uses
+    floor — v0.5 uses floor) — floor here, validated against numpy in tests."""
+
+    params = {
+        "kernel": (TupleParam(2), REQUIRED, "pooling window (h, w)"),
+        "stride": (TupleParam(2), (1, 1), "stride (h, w)"),
+        "pad": (TupleParam(2), (0, 0), "padding (h, w)"),
+        "pool_type": (("max", "avg", "sum"), "max", "pooling reduction"),
+        "global_pool": (bool, False, "pool over the full spatial extent"),
+    }
+
+    def _dims(self, h, w):
+        if self.global_pool:
+            return 1, 1
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        return (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+
+    def infer_shape(self, in_shapes):
+        n, c, h, w = self._known(in_shapes, 0)
+        oh, ow = self._dims(h, w)
+        return [(n, c, h, w)], [(n, c, oh, ow)], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        x = ins[0]
+        if self.global_pool:
+            kernel, stride, pad = (x.shape[2], x.shape[3]), (1, 1), (0, 0)
+        else:
+            kernel, stride, pad = self.kernel, self.stride, self.pad
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
+        padding = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+        if self.pool_type == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = lax.reduce_window(x, init, lax.max, window, strides, padding)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            if self.pool_type == "avg":
+                y = y / (kernel[0] * kernel[1])
+        return [y.astype(x.dtype)], []
+
+
+@register_op("Activation")
+class ActivationOp(OpProp):
+    """Elementwise activations (reference: activation-inl.h + mshadow_op.h)."""
+
+    params = {
+        "act_type": (("relu", "sigmoid", "tanh", "softrelu"), REQUIRED, "activation kind")
+    }
+
+    def fwd(self, ins, aux, is_train, rng):
+        x = ins[0]
+        if self.act_type == "relu":
+            y = jax.nn.relu(x)
+        elif self.act_type == "sigmoid":
+            y = jax.nn.sigmoid(x)
+        elif self.act_type == "tanh":
+            y = jnp.tanh(x)
+        else:  # softrelu = log(1 + exp(x))
+            y = jax.nn.softplus(x)
+        return [y], []
+
+
+@register_op("LeakyReLU")
+class LeakyReLUOp(OpProp):
+    """Leaky/parametric/randomized rectifiers (reference: leaky_relu-inl.h)."""
+
+    params = {
+        "act_type": (("leaky", "prelu", "rrelu", "elu"), "leaky", "variant"),
+        "slope": (float, 0.25, "negative slope (leaky/elu)"),
+        "lower_bound": (float, 0.125, "rrelu slope lower bound"),
+        "upper_bound": (float, 0.334, "rrelu slope upper bound"),
+    }
+
+    need_rng = True
+
+    def list_arguments(self):
+        return ["data", "gamma"] if self.act_type == "prelu" else ["data"]
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        if self.act_type == "prelu":
+            return [d, (d[1],)], [d], []
+        return [d], [d], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        x = ins[0]
+        if self.act_type == "leaky":
+            return [jnp.where(x > 0, x, self.slope * x)], []
+        if self.act_type == "elu":
+            return [jnp.where(x > 0, x, self.slope * (jnp.exp(x) - 1.0))], []
+        if self.act_type == "prelu":
+            gamma = ins[1].astype(x.dtype).reshape((1, -1) + (1,) * (x.ndim - 2))
+            return [jnp.where(x > 0, x, gamma * x)], []
+        # rrelu: random slope per element in train, mean slope in eval
+        if is_train:
+            slope = jax.random.uniform(
+                rng, x.shape, dtype=x.dtype, minval=self.lower_bound, maxval=self.upper_bound
+            )
+            slope = lax.stop_gradient(slope)
+        else:
+            slope = (self.lower_bound + self.upper_bound) / 2.0
+        return [jnp.where(x > 0, x, slope * x)], []
+
+
+@register_op("Dropout")
+class DropoutOp(OpProp):
+    """Inverted dropout (reference: dropout-inl.h — scales by 1/keep at train
+    time, identity at eval)."""
+
+    params = {"p": (float, 0.5, "fraction of units to drop")}
+    need_rng = True
+
+    def fwd(self, ins, aux, is_train, rng):
+        x = ins[0]
+        if not is_train or self.p <= 0.0:
+            return [x], []
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)], []
+
+
+@register_op("BatchNorm")
+class BatchNormOp(OpProp):
+    """Batch normalization with running-stat aux state (reference:
+    batch_norm-inl.h; aux moving_mean/moving_var at :88-108,273).
+
+    Train: normalize by batch stats, update running stats in f32.
+    Eval: normalize by running stats. Gamma/beta are per-channel (axis 1 for
+    NCHW, last axis for 2-D inputs — matching the reference's behavior on
+    fully-connected activations)."""
+
+    params = {
+        "eps": (float, 1e-3, "numerical stability constant"),
+        "momentum": (float, 0.9, "running-average decay"),
+        "fix_gamma": (bool, False, "freeze gamma at 1"),
+    }
+
+    def list_arguments(self):
+        return ["data", "gamma", "beta"]
+
+    def list_auxiliary_states(self):
+        return ["moving_mean", "moving_var"]
+
+    def _channels(self, d):
+        return d[1] if len(d) >= 2 else d[0]
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        c = (self._channels(d),)
+        return [d, c, c], [d], [c, c]
+
+    def fwd(self, ins, aux, is_train, rng):
+        x, gamma, beta = ins
+        moving_mean, moving_var = aux
+        axes = (0,) if x.ndim == 2 else (0, 2, 3)
+        bshape = (1, -1) if x.ndim == 2 else (1, -1, 1, 1)
+        g = (jnp.ones_like(gamma) if self.fix_gamma else gamma).astype(jnp.float32)
+        b = beta.astype(jnp.float32)
+        xf = x.astype(jnp.float32)
+        if is_train:
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            new_mean = self.momentum * moving_mean + (1 - self.momentum) * mean
+            new_var = self.momentum * moving_var + (1 - self.momentum) * var
+            new_aux = [new_mean, new_var]
+        else:
+            mean, var = moving_mean, moving_var
+            new_aux = [moving_mean, moving_var]
+        inv = lax.rsqrt(var + self.eps)
+        y = (xf - mean.reshape(bshape)) * inv.reshape(bshape) * g.reshape(
+            bshape
+        ) + b.reshape(bshape)
+        return [y.astype(x.dtype)], [lax.stop_gradient(a) for a in new_aux]
+
+
+@register_op("LRN")
+class LRNOp(OpProp):
+    """Local response normalization across channels (reference: lrn-inl.h):
+    y = x / (knorm + alpha/n * sum_{window} x²)^beta."""
+
+    params = {
+        "nsize": (int, REQUIRED, "normalization window (channels)"),
+        "alpha": (float, 1e-4, "scale"),
+        "beta": (float, 0.75, "exponent"),
+        "knorm": (float, 2.0, "additive constant"),
+    }
+
+    def fwd(self, ins, aux, is_train, rng):
+        x = ins[0]
+        xf = x.astype(jnp.float32)
+        half = self.nsize // 2
+        sq = jnp.square(xf)
+        # windowed channel sum via reduce_window on axis 1
+        window = (1, self.nsize, 1, 1)
+        pads = ((0, 0), (half, self.nsize - 1 - half), (0, 0), (0, 0))
+        ssum = lax.reduce_window(sq, 0.0, lax.add, window, (1, 1, 1, 1), pads)
+        y = xf * lax.pow(self.knorm + (self.alpha / self.nsize) * ssum, -self.beta)
+        return [y.astype(x.dtype)], []

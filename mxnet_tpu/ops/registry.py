@@ -1,0 +1,182 @@
+"""Operator registry and the OpProp contract.
+
+Reference counterpart: include/mxnet/operator.h — ``OperatorProperty``
+(shape/arg metadata) + ``Operator`` (Forward/Backward kernels) + the
+``MXNET_REGISTER_OP_PROPERTY`` registry, with op configs declared through
+``dmlc::Parameter`` reflection (single source of truth for docs/signatures).
+
+TPU-native redesign: one class per op. The kernel is a *pure function*
+``fwd(ins, aux, is_train, rng) -> (outs, new_aux)`` in jax.numpy/lax —
+traceable, differentiable, fusable by XLA. There is no Backward method:
+autodiff is ``jax.vjp`` of the traced graph, and ops whose reference
+Backward is *not* the true derivative (loss heads) express that via
+``jax.custom_vjp`` inside their forward. ``DeclareBackwardDependency`` /
+inplace metadata disappear into XLA's buffer assignment; resource requests
+(workspace/RNG) become explicit ``rng`` arguments.
+
+Param declaration mirrors dmlc::Parameter: a class-level ``params`` dict of
+``name -> (type, default_or_REQUIRED, doc)``; values are validated and
+normalized at construction, and docstrings are auto-generated from it
+(reference: c_api.cc:378-391 doc export).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..base import MXNetError, Registry
+
+__all__ = ["OpProp", "OPS", "register_op", "REQUIRED", "TupleParam"]
+
+OPS = Registry("operator")
+
+REQUIRED = object()
+
+
+class TupleParam:
+    """Marker type for int-tuple params like kernel/stride/pad ('(2,2)' ok)."""
+
+    def __init__(self, length=None):
+        self.length = length
+
+    def __call__(self, value):
+        if isinstance(value, str):
+            value = ast.literal_eval(value)
+        if isinstance(value, int):
+            value = (value,) * (self.length or 1)
+        value = tuple(int(v) for v in value)
+        if self.length is not None and len(value) != self.length:
+            raise MXNetError(f"expected tuple of length {self.length}, got {value}")
+        return value
+
+
+def _coerce(typ, value):
+    if typ is bool and isinstance(value, str):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(typ, TupleParam):
+        return typ(value)
+    if isinstance(typ, tuple):  # enum of strings
+        if value not in typ:
+            raise MXNetError(f"expected one of {typ}, got {value!r}")
+        return value
+    return typ(value)
+
+
+class OpProp:
+    """Base class for operator properties (metadata + pure-fn kernel).
+
+    Subclasses define:
+      params       : dict name -> (type, default|REQUIRED, doc)
+      list_arguments / list_outputs / list_auxiliary_states
+      infer_shape(in_shapes) -> (in_shapes, out_shapes, aux_shapes)
+      fwd(ins, aux, is_train, rng) -> (outs, new_aux)
+      need_rng     : True if fwd consumes randomness in training mode
+    """
+
+    params: dict = {}
+    need_rng = False
+    # Non-None => executor treats output[0] as a loss head whose gradient is
+    # injected by the op's custom_vjp (cotangent ignored), matching the
+    # reference's loss-op Backward semantics.
+    is_loss = False
+
+    def __init__(self, **kwargs):
+        self.attr = {}
+        spec = type(self).params
+        for key, value in kwargs.items():
+            if key not in spec:
+                raise MXNetError(
+                    f"{type(self).__name__}: unknown parameter {key!r}; "
+                    f"accepts {sorted(spec)}"
+                )
+            typ = spec[key][0]
+            self.attr[key] = _coerce(typ, value)
+        for key, (typ, default, _doc) in spec.items():
+            if key not in self.attr:
+                if default is REQUIRED:
+                    raise MXNetError(f"{type(self).__name__}: parameter {key!r} is required")
+                self.attr[key] = default
+
+    def __getattr__(self, item):
+        try:
+            return self.__dict__["attr"][item]
+        except KeyError:
+            raise AttributeError(item) from None
+
+    # -- metadata -------------------------------------------------------------
+    @property
+    def name(self):
+        return type(self).op_name
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def num_inputs(self):
+        return len(self.list_arguments())
+
+    def num_outputs(self):
+        return len(self.list_outputs())
+
+    # -- shape inference ------------------------------------------------------
+    def infer_shape(self, in_shapes):
+        """Complete partial input shapes; return (in, out, aux) shape lists.
+
+        ``in_shapes`` entries are tuples or None (unknown). The default
+        requires the first input and propagates it elementwise.
+        """
+        d = self._known(in_shapes, 0)
+        return [d] * len(in_shapes), [d], []
+
+    def _known(self, in_shapes, idx):
+        s = in_shapes[idx]
+        if s is None:
+            raise MXNetError(
+                f"{self.name}: shape of input '{self.list_arguments()[idx]}' unknown"
+            )
+        return tuple(s)
+
+    # -- kernel ---------------------------------------------------------------
+    def fwd(self, ins, aux, is_train, rng):
+        raise NotImplementedError
+
+    def serialize_params(self) -> dict:
+        """JSON-able param dict for Symbol save/load."""
+        return {k: (list(v) if isinstance(v, tuple) else v) for k, v in self.attr.items()}
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.attr})"
+
+
+def register_op(op_name, aliases=()):
+    """Register an OpProp subclass under ``op_name`` (+ optional aliases)."""
+
+    def _reg(cls):
+        cls.op_name = op_name
+        cls.op_aliases = tuple(aliases)
+        OPS.register(op_name)(cls)
+        for alias in aliases:
+            OPS._entries[alias.lower()] = cls
+        _autodoc(cls)
+        return cls
+
+    return _reg
+
+
+def _autodoc(cls):
+    if not cls.params:
+        return
+    lines = [cls.__doc__ or "", "", "Parameters", "----------"]
+    for key, (typ, default, doc) in cls.params.items():
+        tname = getattr(typ, "__name__", None) or (
+            f"one of {typ}" if isinstance(typ, tuple) else "tuple of int"
+        )
+        req = "required" if default is REQUIRED else f"default={default!r}"
+        lines.append(f"{key} : {tname}, {req}")
+        lines.append(f"    {doc}")
+    cls.__doc__ = "\n".join(lines)

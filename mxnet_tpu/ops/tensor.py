@@ -1,0 +1,330 @@
+"""Structural and elementwise symbolic operators.
+
+Reference counterparts: src/operator/elementwise_binary_op.cc (_Plus.._Div),
+elementwise_sum, concat, slice_channel, reshape/flatten, block_grad, and the
+TBlob-registry unary ops square/sqrt/exp/log (src/ndarray/unary_function-inl.h).
+All are direct jax.numpy expressions; XLA fuses them into neighbors, so there
+is nothing to hand-optimize here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import OpProp, REQUIRED, register_op
+
+
+class _BinaryOp(OpProp):
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shapes):
+        s = in_shapes[0] or in_shapes[1]
+        if s is None:
+            raise MXNetError(f"{self.name}: both input shapes unknown")
+        s = tuple(s)
+        return [s, s], [s], []
+
+
+@register_op("_Plus", aliases=["elemwise_add"])
+class PlusOp(_BinaryOp):
+    """Elementwise addition."""
+
+    def fwd(self, ins, aux, is_train, rng):
+        return [ins[0] + ins[1]], []
+
+
+@register_op("_Minus")
+class MinusOp(_BinaryOp):
+    """Elementwise subtraction."""
+
+    def fwd(self, ins, aux, is_train, rng):
+        return [ins[0] - ins[1]], []
+
+
+@register_op("_Mul")
+class MulOp(_BinaryOp):
+    """Elementwise multiplication."""
+
+    def fwd(self, ins, aux, is_train, rng):
+        return [ins[0] * ins[1]], []
+
+
+@register_op("_Div")
+class DivOp(_BinaryOp):
+    """Elementwise division."""
+
+    def fwd(self, ins, aux, is_train, rng):
+        return [ins[0] / ins[1]], []
+
+
+@register_op("ElementWiseSum", aliases=["add_n"])
+class ElementWiseSumOp(OpProp):
+    """Sum of N inputs (reference: elementwise_sum-inl.h; also the node type
+    the reference's autodiff inserts for gradient aggregation)."""
+
+    params = {"num_args": (int, REQUIRED, "number of inputs")}
+
+    def list_arguments(self):
+        return [f"arg{i}" for i in range(self.num_args)]
+
+    def infer_shape(self, in_shapes):
+        s = next((tuple(x) for x in in_shapes if x is not None), None)
+        if s is None:
+            raise MXNetError("ElementWiseSum: no input shape known")
+        return [s] * self.num_args, [s], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        out = ins[0]
+        for x in ins[1:]:
+            out = out + x
+        return [out], []
+
+
+@register_op("Concat")
+class ConcatOp(OpProp):
+    """Concatenate along ``dim`` (reference: concat-inl.h, default channel dim 1)."""
+
+    params = {
+        "num_args": (int, REQUIRED, "number of inputs"),
+        "dim": (int, 1, "dimension to concatenate along"),
+    }
+
+    def list_arguments(self):
+        return [f"arg{i}" for i in range(self.num_args)]
+
+    def infer_shape(self, in_shapes):
+        known = [tuple(s) for s in in_shapes if s is not None]
+        if not known:
+            raise MXNetError("Concat: no input shape known")
+        ndim, dim = len(known[0]), self.dim
+        out = list(known[0])
+        out[dim] = 0
+        filled = []
+        for s in in_shapes:
+            if s is None:
+                s = known[0]  # assume equal share when unknown
+            s = tuple(s)
+            if len(s) != ndim:
+                raise MXNetError("Concat: rank mismatch")
+            for ax in range(ndim):
+                if ax != dim and s[ax] != out[ax]:
+                    raise MXNetError(f"Concat: shape mismatch {s} vs {tuple(out)}")
+            out[dim] += s[dim]
+            filled.append(s)
+        return filled, [tuple(out)], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        return [jnp.concatenate(ins, axis=self.dim)], []
+
+
+@register_op("SliceChannel")
+class SliceChannelOp(OpProp):
+    """Split along axis 1 into ``num_outputs`` equal parts (reference:
+    slice_channel-inl.h; used to split LSTM gates)."""
+
+    params = {
+        "num_outputs": (int, REQUIRED, "number of output splits"),
+        "axis": (int, 1, "axis to split along (extension; reference fixes 1)"),
+        "squeeze_axis": (bool, False, "remove the split axis if it becomes 1"),
+    }
+
+    def _n(self):
+        # the param name collides with OpProp.num_outputs(); read the attr
+        return self.attr["num_outputs"]
+
+    def list_outputs(self):
+        return [f"output{i}" for i in range(self._n())]
+
+    def infer_shape(self, in_shapes):
+        d = list(self._known(in_shapes, 0))
+        ax = self.axis
+        if d[ax] % self._n() != 0:
+            raise MXNetError(
+                f"SliceChannel: dim {d[ax]} not divisible by {self._n()}"
+            )
+        d[ax] //= self._n()
+        if self.squeeze_axis:
+            # reference contract: squeeze_axis requires the split axis to
+            # divide down to 1, so inference and execution always agree
+            if d[ax] != 1:
+                raise MXNetError(
+                    "SliceChannel: squeeze_axis requires axis size == "
+                    f"num_outputs, got {d[ax] * self._n()} / {self._n()}"
+                )
+            out = tuple(d[:ax] + d[ax + 1 :])
+        else:
+            out = tuple(d)
+        return [tuple(self._known(in_shapes, 0))], [out] * self._n(), []
+
+    def fwd(self, ins, aux, is_train, rng):
+        parts = jnp.split(ins[0], self._n(), axis=self.axis)
+        if self.squeeze_axis:
+            parts = [jnp.squeeze(p, axis=self.axis) for p in parts]
+        return parts, []
+
+
+@register_op("Reshape")
+class ReshapeOp(OpProp):
+    """Reshape to ``target_shape`` (reference: reshape-inl.h; first dim 0 keeps
+    the batch dim, -1 infers — superset of the v0.5 exact-shape behavior)."""
+
+    # target_shape accepts tuple/list/str; normalized in __init__.
+    params = {"target_shape": ((lambda v: v), REQUIRED, "new shape")}
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        ts = self.attr["target_shape"]
+        if isinstance(ts, str):
+            import ast
+
+            ts = ast.literal_eval(ts)
+        self.attr["target_shape"] = tuple(int(x) for x in ts)
+
+    def _resolve(self, in_shape):
+        ts = list(self.target_shape)
+        if ts and ts[0] == 0:
+            ts[0] = in_shape[0]
+        size = 1
+        for d in in_shape:
+            size *= d
+        if -1 in ts:
+            i = ts.index(-1)
+            rest = 1
+            for d in ts[:i] + ts[i + 1 :]:
+                rest *= d
+            ts[i] = size // rest
+        return tuple(ts)
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        return [d], [self._resolve(d)], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        return [jnp.reshape(ins[0], self._resolve(ins[0].shape))], []
+
+
+@register_op("Flatten")
+class FlattenOp(OpProp):
+    """Collapse all dims after the first (reference: reshape-inl.h Flatten)."""
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        flat = 1
+        for x in d[1:]:
+            flat *= x
+        return [d], [(d[0], flat)], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        x = ins[0]
+        return [jnp.reshape(x, (x.shape[0], -1))], []
+
+
+@register_op("BlockGrad")
+class BlockGradOp(OpProp):
+    """Identity forward, zero gradient (reference: block_grad-inl.h) —
+    exactly ``jax.lax.stop_gradient``."""
+
+    def fwd(self, ins, aux, is_train, rng):
+        return [jax.lax.stop_gradient(ins[0])], []
+
+
+@register_op("Transpose")
+class TransposeOp(OpProp):
+    """Transpose (extension beyond v0.5, needed by attention models)."""
+
+    params = {"axes": (lambda v: v, None, "permutation, default reverse")}
+
+    def _axes(self, ndim):
+        axes = self.attr["axes"]
+        if axes is None:
+            return tuple(reversed(range(ndim)))
+        if isinstance(axes, str):
+            import ast
+
+            axes = ast.literal_eval(axes)
+        return tuple(int(a) for a in axes)
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        axes = self._axes(len(d))
+        return [d], [tuple(d[a] for a in axes)], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        return [jnp.transpose(ins[0], self._axes(ins[0].ndim))], []
+
+
+class _UnaryOp(OpProp):
+    """Base for the TBlob-registry unary math ops (reference:
+    src/common/tblob_op_registry.cc — registered once, exposed as both
+    NDArray function and Symbol; here the NDArray exposure lives in
+    mxnet_tpu.ndarray and shares nothing but the name)."""
+
+    _fn = None
+
+    def fwd(self, ins, aux, is_train, rng):
+        return [type(self)._fn(ins[0])], []
+
+
+@register_op("square")
+class SquareOp(_UnaryOp):
+    _fn = staticmethod(jnp.square)
+
+
+@register_op("sqrt")
+class SqrtOp(_UnaryOp):
+    _fn = staticmethod(jnp.sqrt)
+
+
+@register_op("exp")
+class ExpOp(_UnaryOp):
+    _fn = staticmethod(jnp.exp)
+
+
+@register_op("log")
+class LogOp(_UnaryOp):
+    _fn = staticmethod(jnp.log)
+
+
+@register_op("abs")
+class AbsOp(_UnaryOp):
+    _fn = staticmethod(jnp.abs)
+
+
+@register_op("norm")
+class NormOp(OpProp):
+    """L2 norm reduction to a length-1 vector (reference: unary_function-inl.h)."""
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        return [d], [(1,)], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        x = ins[0]
+        return [jnp.sqrt(jnp.sum(jnp.square(x))).reshape((1,))], []
+
+
+@register_op("Embedding")
+class EmbeddingOp(OpProp):
+    """Token embedding lookup (extension beyond v0.5; required by the LSTM/
+    transformer language-model zoo). TPU note: lowered as one-hot-free
+    ``jnp.take`` gather."""
+
+    params = {
+        "input_dim": (int, REQUIRED, "vocabulary size"),
+        "output_dim": (int, REQUIRED, "embedding dimension"),
+    }
+
+    def list_arguments(self):
+        return ["data", "weight"]
+
+    def infer_shape(self, in_shapes):
+        d = self._known(in_shapes, 0)
+        w = (self.input_dim, self.output_dim)
+        return [d, w], [d + (self.output_dim,)], []
+
+    def fwd(self, ins, aux, is_train, rng):
+        data, weight = ins
+        return [jnp.take(weight, data.astype(jnp.int32), axis=0)], []

@@ -1,0 +1,28 @@
+"""Parallelism over TPU device meshes.
+
+This package is the TPU-native replacement for the reference's entire
+distributed layer (src/kvstore/ + ps-lite, SURVEY.md §2.4) and its *absent*
+sequence dimension (§5 long-context): instead of parameter servers, a
+``jax.sharding.Mesh`` with named axes
+
+    dp - data parallel (batch)            ≙ kvstore local/device/dist_sync
+    tp - tensor parallel (hidden)         (new capability)
+    sp - sequence/context parallel        (new capability; ring attention)
+    pp - pipeline parallel (layers)       (new capability)
+    ep - expert parallel (MoE)            (new capability)
+
+and XLA collectives over ICI/DCN (psum/all_gather/ppermute/reduce_scatter).
+"""
+
+from .mesh import make_mesh, auto_mesh, data_sharding, replicated
+from .data_parallel import shard_batch, replicate_params, allreduce_grads
+from .tensor_parallel import (column_parallel, row_parallel,
+                              transformer_param_specs)
+from .sequence import ring_attention, ring_self_attention, attention_reference
+
+__all__ = [
+    "make_mesh", "auto_mesh", "data_sharding", "replicated",
+    "shard_batch", "replicate_params", "allreduce_grads",
+    "column_parallel", "row_parallel", "transformer_param_specs",
+    "ring_attention", "ring_self_attention", "attention_reference",
+]

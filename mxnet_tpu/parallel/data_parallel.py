@@ -1,0 +1,36 @@
+"""Data-parallel helpers (≙ kvstore local/device/dist_sync, SURVEY.md §2.4).
+
+Inside a jitted step over a mesh, gradient allreduce is inserted by the SPMD
+partitioner (params replicated, batch sharded) — ``allreduce_grads`` exists
+for the explicit shard_map style and for KVStore's fast path.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["shard_batch", "replicate_params", "allreduce_grads"]
+
+
+def shard_batch(batch, mesh, axis="dp"):
+    """Place a pytree of host arrays batch-sharded on the mesh."""
+    sh = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
+
+
+def replicate_params(params, mesh):
+    sh = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), params)
+
+
+def allreduce_grads(grads, axis_name="dp", average=True):
+    """psum (optionally mean) over the data axis — call inside shard_map.
+
+    ≙ the reference's ReduceSumCPU + dist_sync server accumulate
+    (kvstore_local.h:180-235, kvstore_dist_server.h:164-193)."""
+    n = jax.lax.psum(1, axis_name)
+    summed = jax.tree_util.tree_map(lambda g: jax.lax.psum(g, axis_name), grads)
+    if average:
+        return jax.tree_util.tree_map(lambda g: g / n, summed)
+    return summed

@@ -1,0 +1,57 @@
+"""Device-mesh construction with the canonical axis names (dp, tp, sp, pp, ep).
+
+Axis order places ``tp``/``sp`` innermost so they map onto the
+highest-bandwidth ICI neighbors on a real slice, with ``dp`` outermost
+(crossing DCN on multi-host) — the standard layout from the scaling
+playbook: collectives that move activations ride ICI, gradient reduction
+amortizes over DCN.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "auto_mesh", "data_sharding", "replicated", "AXES"]
+
+AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+def make_mesh(dp=1, tp=1, sp=1, pp=1, ep=1, devices=None) -> Mesh:
+    """Build a mesh with the named axes; sizes must multiply to #devices."""
+    if devices is None:
+        devices = jax.devices()
+    sizes = {"dp": dp, "pp": pp, "ep": ep, "sp": sp, "tp": tp}
+    total = int(np.prod(list(sizes.values())))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape([sizes[a] for a in AXES])
+    return Mesh(arr, AXES)
+
+
+def auto_mesh(n_devices=None, tp=1, sp=1, pp=1, ep=1, devices=None) -> Mesh:
+    """Mesh with dp filling whatever the fixed axes leave over."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    fixed = tp * sp * pp * ep
+    if len(devices) % fixed:
+        raise ValueError(f"{len(devices)} devices not divisible by tp*sp*pp*ep={fixed}")
+    return make_mesh(dp=len(devices) // fixed, tp=tp, sp=sp, pp=pp, ep=ep,
+                     devices=devices)
+
+
+def data_sharding(mesh: Mesh, extra_axis=None) -> NamedSharding:
+    """Batch-dim sharding over dp (optionally dp+sp for sequence inputs)."""
+    if extra_axis:
+        return NamedSharding(mesh, P("dp", extra_axis))
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,101 @@
+"""Sequence/context parallelism: ring attention over the 'sp' mesh axis.
+
+New capability vs. the reference, whose only sequence story is graph
+unrolling (SURVEY.md §5 long-context; example/rnn/lstm.py). Design follows
+the ring-attention pattern: keys/values rotate around the sp ring via
+``ppermute`` while each shard accumulates its queries' attention with a
+numerically-stable online softmax — sequence length scales linearly with the
+number of chips, and each hop overlaps the next block's compute (the
+collective-permute rides ICI).
+
+Use ``ring_self_attention`` inside ``shard_map`` with q/k/v sharded on their
+sequence dim over 'sp'; ``attention_reference`` is the dense equivalent used
+for numerics tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ring_self_attention", "attention_reference"]
+
+
+def attention_reference(q, k, v, causal=False):
+    """Dense softmax attention; q,k,v: [batch, heads, seq, head_dim]."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s / jnp.sqrt(jnp.float32(d))
+    if causal:
+        qpos = jnp.arange(q.shape[2])[:, None]
+        kpos = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False):
+    """Ring attention for sequence-sharded q/k/v (call inside shard_map).
+
+    Shapes per shard: [batch, heads, seq/sp, head_dim]. Returns the exact
+    same result as dense attention over the gathered sequence (up to fp
+    accumulation order), with O(seq/sp) memory per chip.
+    """
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    qf = q.astype(jnp.float32)
+
+    q_pos = my_idx * sq + jnp.arange(sq)  # global query positions
+
+    def step(carry, i):
+        k_blk, v_blk, o, m, l = carry
+        src = (my_idx - i) % n  # which shard this k/v block came from
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
+        if causal:
+            kv_pos = src * skv + jnp.arange(skv)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # guard fully-masked rows (exp(-inf - -inf)); keep them at zero weight
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - safe_m[..., None])
+        if causal:
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        # rotate k/v to the next device on the ring
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (_, _, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0), jnp.arange(n))
+    o = o / jnp.maximum(l[..., None], 1e-30)
+    return o.astype(q.dtype)
+
+
+def ring_self_attention(mesh, q, k, v, causal=False):
+    """Convenience wrapper: shard_map ring_attention over mesh axis 'sp',
+    with batch on 'dp' and heads on 'tp'."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P("dp", "tp", "sp", None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,70 @@
+"""Tensor (model) parallelism over the 'tp' mesh axis.
+
+New capability vs. the reference (which is data-parallel only, SURVEY.md
+§2.4): Megatron-style sharded matmuls expressed with sharding constraints —
+XLA's SPMD partitioner turns the column→row pair into one all-reduce on the
+activations, riding ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["column_parallel", "row_parallel", "transformer_param_specs"]
+
+
+def column_parallel(x, w, b=None):
+    """y = x @ w where w is sharded on its output (last) dim over 'tp'.
+
+    Output stays tp-sharded on the feature dim; follow with row_parallel."""
+    y = jnp.einsum("...d,df->...f", x, w.astype(x.dtype),
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    if b is not None:
+        y = y + b.astype(x.dtype)
+    return y
+
+
+def row_parallel(x, w, b=None):
+    """y = x @ w where w is sharded on its input (first) dim over 'tp';
+    the partitioner inserts the psum that completes the contraction."""
+    y = jnp.einsum("...f,fd->...d", x, w.astype(x.dtype),
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    if b is not None:
+        y = y + b.astype(x.dtype)
+    return y
+
+
+def transformer_param_specs(n_layers: int) -> dict:
+    """PartitionSpecs for a standard transformer block stack, keyed by
+    parameter name pattern. Convention:
+      attention qkv:  (d_model, 3*d_head*n_head) -> shard heads over tp
+      attention out:  (d_head*n_head, d_model)   -> shard input over tp
+      mlp up:         (d_model, d_ff)            -> column (tp on d_ff)
+      mlp down:       (d_ff, d_model)            -> row (tp on d_ff)
+      embeddings:     (vocab, d_model)           -> shard vocab over tp
+      norms/biases:   replicated
+    """
+    spec = {
+        "embed": P("tp", None),
+        "pos_embed": P(),
+        "final_norm_scale": P(),
+        "final_norm_bias": P(),
+        "lm_head": P(None, "tp"),
+    }
+    for i in range(n_layers):
+        spec.update({
+            f"layer{i}_wqkv": P(None, "tp"),
+            f"layer{i}_wo": P("tp", None),
+            f"layer{i}_w1": P(None, "tp"),
+            f"layer{i}_b1": P("tp"),
+            f"layer{i}_w2": P("tp", None),
+            f"layer{i}_b2": P(),
+            f"layer{i}_ln1_scale": P(),
+            f"layer{i}_ln1_bias": P(),
+            f"layer{i}_ln2_scale": P(),
+            f"layer{i}_ln2_bias": P(),
+        })
+    return spec

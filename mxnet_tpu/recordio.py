@@ -1,0 +1,177 @@
+"""RecordIO: length-delimited binary record files with an optional index.
+
+Reference counterpart: dmlc-core recordio + src/io/image_recordio.h +
+python/mxnet/recordio.py + tools/im2rec.cc. The on-disk format here is a
+fresh design (magic+crc framing, 8-byte alignment for mmap-friendly reads)
+— the reference format is not bit-compatible, but the API surface
+(MXRecordIO/MXIndexedRecordIO/IRHeader/pack/unpack/pack_img) matches, and
+tools/im2rec.py packs image folders the same way.
+
+A C++ reader with the same format lives in mxnet_tpu/native for the
+high-throughput path; this module is the pure-Python reference
+implementation and the writer.
+"""
+
+from __future__ import annotations
+
+import io as _pyio
+import os
+import struct
+import zlib
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img", "RECORD_MAGIC"]
+
+RECORD_MAGIC = 0x54524543  # 'CREC'
+_HEADER = struct.Struct("<IIQ")  # magic, crc32(data), length
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (reference: python/mxnet/recordio.py)."""
+
+    def __init__(self, uri: str, flag: str):
+        if flag not in ("r", "w"):
+            raise MXNetError("flag must be 'r' or 'w'")
+        self.uri = uri
+        self.flag = flag
+        self.open()
+
+    def open(self):
+        self._f = open(self.uri, "rb" if self.flag == "r" else "wb")
+
+    def close(self):
+        if self._f:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self) -> int:
+        return self._f.tell()
+
+    def write(self, buf: bytes) -> int:
+        """Append one record; returns its file offset (usable as index)."""
+        if self.flag != "w":
+            raise MXNetError("recordio not opened for writing")
+        pos = self._f.tell()
+        self._f.write(_HEADER.pack(RECORD_MAGIC, zlib.crc32(buf), len(buf)))
+        self._f.write(buf)
+        pad = (-len(buf)) % 8
+        if pad:
+            self._f.write(b"\x00" * pad)
+        return pos
+
+    def read(self) -> bytes | None:
+        """Read the next record, or None at EOF."""
+        if self.flag != "r":
+            raise MXNetError("recordio not opened for reading")
+        header = self._f.read(_HEADER.size)
+        if len(header) < _HEADER.size:
+            return None
+        magic, crc, length = _HEADER.unpack(header)
+        if magic != RECORD_MAGIC:
+            raise MXNetError(f"corrupt record file {self.uri!r}: bad magic")
+        buf = self._f.read(length)
+        if len(buf) < length:
+            raise MXNetError(f"truncated record in {self.uri!r}")
+        if zlib.crc32(buf) != crc:
+            raise MXNetError(f"crc mismatch in {self.uri!r}")
+        pad = (-length) % 8
+        if pad:
+            self._f.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access records via a sidecar `.idx` file of `key\\toffset` lines."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str):
+        self.idx_path = idx_path
+        self.idx: dict[int, int] = {}
+        self.keys: list[int] = []
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    key, off = line.strip().split("\t")
+                    self.idx[int(key)] = int(off)
+                    self.keys.append(int(key))
+
+    def close(self):
+        if self.flag == "w" and getattr(self, "_f", None):
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def write_idx(self, idx: int, buf: bytes):
+        pos = self.write(buf)
+        self.idx[idx] = pos
+        self.keys.append(idx)
+
+    def read_idx(self, idx: int) -> bytes:
+        self._f.seek(self.idx[idx])
+        return self.read()
+
+
+# label header packed in front of image payloads (reference: image_recordio.h)
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR = struct.Struct("<IfQQ")
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack an IRHeader + payload into one record buffer.
+
+    flag > 0 means the label is a float vector of length ``flag`` stored
+    after the fixed header (multi-label support, as in the reference)."""
+    header = IRHeader(*header)
+    if header.flag > 0:
+        label = np.asarray(header.label, dtype=np.float32)
+        if label.size != header.flag:
+            raise MXNetError("label length != flag")
+        payload = _IR.pack(header.flag, 0.0, header.id, header.id2) + label.tobytes() + s
+    else:
+        payload = _IR.pack(0, float(header.label), header.id, header.id2) + s
+    return payload
+
+
+def unpack(s: bytes):
+    flag, label, id_, id2 = _IR.unpack(s[: _IR.size])
+    s = s[_IR.size :]
+    if flag > 0:
+        vec = np.frombuffer(s[: flag * 4], dtype=np.float32)
+        return IRHeader(flag, vec, id_, id2), s[flag * 4 :]
+    return IRHeader(0, label, id_, id2), s
+
+
+def pack_img(header: IRHeader, img: np.ndarray, quality=95, img_fmt=".jpg") -> bytes:
+    """Encode an HWC uint8 image and pack it (reference: recordio.pack_img;
+    OpenCV imencode replaced by PIL)."""
+    from PIL import Image
+
+    buf = _pyio.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    Image.fromarray(img).save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s: bytes):
+    """Decode a packed image record to (IRHeader, HWC uint8 array)."""
+    from PIL import Image
+
+    header, img_bytes = unpack(s)
+    img = np.asarray(Image.open(_pyio.BytesIO(img_bytes)).convert("RGB"))
+    return header, img

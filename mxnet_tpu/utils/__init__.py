@@ -1,0 +1,11 @@
+"""Utilities: memory stats, profiling hooks, env-var catalog.
+
+Replaces reference subsystems that vanish on TPU:
+  - src/storage/ pooled allocator  -> ``memory_stats`` over the XLA runtime
+  - ENGINE_DEBUG / MXNET_ENGINE_INFO -> ``profiler`` (JAX trace) + jit logs
+"""
+
+from .memory import memory_stats
+from .profiler import profile_scope, start_trace, stop_trace
+
+__all__ = ["memory_stats", "profile_scope", "start_trace", "stop_trace"]

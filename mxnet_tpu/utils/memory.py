@@ -1,0 +1,28 @@
+"""Device memory introspection (replaces the reference's storage manager
+stats and GraphExecutor::Print 'Total N MB allocated' — SURVEY.md §5 requires
+keeping the memcost regression story; see also Executor.debug_str)."""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["memory_stats"]
+
+
+def memory_stats(device=None) -> dict:
+    """Per-device allocator stats {bytes_in_use, peak_bytes_in_use, ...}.
+
+    Returns zeros when the backend doesn't expose stats (CPU test runs)."""
+    devices = [device] if device is not None else jax.devices()
+    out = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        out[str(d)] = {
+            "bytes_in_use": stats.get("bytes_in_use", 0),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+            "bytes_limit": stats.get("bytes_limit", 0),
+        }
+    return out

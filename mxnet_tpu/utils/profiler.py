@@ -1,0 +1,41 @@
+"""First-class profiling (SURVEY.md §5: the reference's tracing story is
+thin — engine debug logs + a python Speedometer; here profiling surfaces the
+JAX/XProf trace machinery directly)."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+__all__ = ["start_trace", "stop_trace", "profile_scope", "Timer"]
+
+
+def start_trace(log_dir: str):
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace():
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def profile_scope(name: str):
+    """Annotate a host-side region; nests into device traces via TraceAnnotation."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class Timer:
+    """Wall-clock timer that blocks on device work for honest measurements
+    (≙ dmlc/timer.h + WaitForAll in the reference's engine benchmarks)."""
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        jax.effects_barrier()
+        self.elapsed = time.perf_counter() - self.start
+        return False

@@ -1,0 +1,41 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Mirrors the reference's approach to distributed testing without a cluster
+(SURVEY.md §4: dmlc_local.py multi-process on one machine) — here a single
+process with 8 XLA host devices exercises every sharding/collective path.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Force CPU: the session environment pins JAX_PLATFORMS=axon (the real TPU
+# tunnel), which must stay reserved for bench runs — unit tests run on the
+# 8-device virtual CPU mesh. sitecustomize imports jax before this file runs,
+# so the env var alone is too late; update the live config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# This JAX build mirrors TPU MXU semantics even on CPU: under jit, f32
+# matmul operands are truncated to bf16 at default precision. Numeric tests
+# need exact f32 contractions; the framework itself leaves precision at the
+# backend default (the TPU fast path).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    """Deterministic tests: reseed numpy and the framework PRNG per test."""
+    np.random.seed(0)
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,102 @@
+"""Executor bind tests (reference: tests/python/unittest/test_bind.py —
+bind + gradient correctness vs numpy for composed graphs)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _same(a, b, tol=1e-4):
+    np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+
+def test_bind_mul_graph():
+    lhs = sym.Variable("lhs")
+    rhs = sym.Variable("rhs")
+    net = lhs * rhs
+    shape = (4, 4)
+    lv = np.random.uniform(-1, 1, shape).astype(np.float32)
+    rv = np.random.uniform(-1, 1, shape).astype(np.float32)
+    args = {"lhs": mx.nd.array(lv), "rhs": mx.nd.array(rv)}
+    grads = {"lhs": mx.nd.zeros(shape), "rhs": mx.nd.zeros(shape)}
+    exe = net.bind(mx.cpu(), args=args, args_grad=grads)
+    (o,) = exe.forward(is_train=True)
+    _same(o.asnumpy(), lv * rv)
+    og = np.random.uniform(-1, 1, shape).astype(np.float32)
+    exe.backward([mx.nd.array(og)])
+    _same(grads["lhs"].asnumpy(), og * rv)
+    _same(grads["rhs"].asnumpy(), og * lv)
+
+
+def test_bind_positional_lists():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    net = a + b
+    shape = (3, 3)
+    args = [mx.nd.ones(shape), mx.nd.ones(shape)]
+    grads = [mx.nd.zeros(shape), mx.nd.zeros(shape)]
+    exe = net.bind(mx.cpu(), args=args, args_grad=grads)
+    (o,) = exe.forward(is_train=True)
+    _same(o.asnumpy(), np.full(shape, 2.0))
+    exe.backward([mx.nd.ones(shape)])
+    _same(grads[0].asnumpy(), np.ones(shape))
+
+
+def test_grad_req_add():
+    x = sym.Variable("x")
+    net = x * x
+    shape = (2, 2)
+    xv = np.full(shape, 3.0, np.float32)
+    args = {"x": mx.nd.array(xv)}
+    grads = {"x": mx.nd.zeros(shape)}
+    exe = net.bind(mx.cpu(), args=args, args_grad=grads, grad_req="add")
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones(shape)])
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones(shape)])
+    _same(grads["x"].asnumpy(), 2 * 2 * xv)  # accumulated twice
+
+
+def test_grad_req_null():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    net = x * y
+    shape = (2, 2)
+    args = {"x": mx.nd.ones(shape), "y": mx.nd.ones(shape)}
+    grads = {"x": mx.nd.zeros(shape)}
+    exe = net.bind(mx.cpu(), args=args, args_grad=grads,
+                   grad_req={"x": "write", "y": "null"})
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones(shape)])
+    _same(grads["x"].asnumpy(), np.ones(shape))
+
+
+def test_forward_kwargs_update_args():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc", num_hidden=2)
+    exe = net.simple_bind(mx.cpu(), data=(3, 4))
+    w = np.random.uniform(size=(2, 4)).astype(np.float32)
+    exe.arg_dict["fc_weight"][:] = w
+    dv = np.random.uniform(size=(3, 4)).astype(np.float32)
+    (o,) = exe.forward(data=mx.nd.array(dv))
+    _same(o.asnumpy(), dv @ w.T, tol=1e-4)
+    _same(exe.arg_dict["data"].asnumpy(), dv)
+
+
+def test_copy_params_from():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc", num_hidden=2)
+    exe = net.simple_bind(mx.cpu(), data=(3, 4))
+    w = mx.nd.array(np.random.uniform(size=(2, 4)).astype(np.float32))
+    exe.copy_params_from({"fc_weight": w})
+    _same(exe.arg_dict["fc_weight"].asnumpy(), w.asnumpy())
+
+
+def test_debug_str():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc", num_hidden=2)
+    exe = net.simple_bind(mx.cpu(), data=(3, 4))
+    exe.forward()
+    s = exe.debug_str()
+    assert "fc" in s

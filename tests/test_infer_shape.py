@@ -1,0 +1,79 @@
+"""Shape-inference tests (reference: tests/python/unittest/test_infer_shape.py)."""
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def test_mlp_infer_shape():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data=data, name="fc1", num_hidden=1000)
+    out = sym.Activation(data=out, act_type="relu")
+    out = sym.FullyConnected(data=out, name="fc2", num_hidden=10)
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(100, 100))
+    names = out.list_arguments()
+    d = dict(zip(names, arg_shapes))
+    assert d["fc1_weight"] == (1000, 100)
+    assert d["fc1_bias"] == (1000,)
+    assert d["fc2_weight"] == (10, 1000)
+    assert out_shapes == [(100, 10)]
+    assert aux_shapes == []
+
+
+def test_conv_infer_shape():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data=data, num_filter=32, kernel=(3, 3), pad=(1, 1))
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 3, 16, 16))
+    d = dict(zip(conv.list_arguments(), arg_shapes))
+    assert d[f"{conv.name}_weight"] == (32, 3, 3, 3)
+    assert out_shapes == [(2, 32, 16, 16)]
+
+
+def test_batchnorm_aux_shape():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, name="bn")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(4, 8, 5, 5))
+    assert aux_shapes == [(8,), (8,)]
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_softmax_label_shape_inferred():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, name="fc", num_hidden=10)
+    net = sym.SoftmaxOutput(data=fc, name="softmax")
+    arg_shapes, _, _ = net.infer_shape(data=(32, 100))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["softmax_label"] == (32,)
+
+
+def test_incomplete_infer_raises():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=10)
+    with pytest.raises(mx.MXNetError):
+        net.infer_shape()
+
+
+def test_mismatch_raises():
+    lhs = sym.Variable("lhs")
+    rhs = sym.Variable("rhs")
+    net = lhs + rhs
+    with pytest.raises(mx.MXNetError):
+        net.infer_shape(lhs=(2, 3), rhs=(3, 2))
+
+
+def test_pooling_global():
+    data = sym.Variable("data")
+    p = sym.Pooling(data=data, kernel=(1, 1), global_pool=True, pool_type="avg")
+    _, out_shapes, _ = p.infer_shape(data=(2, 16, 7, 7))
+    assert out_shapes == [(2, 16, 1, 1)]
+
+
+def test_reshape_flatten():
+    data = sym.Variable("data")
+    r = sym.Reshape(data=data, target_shape=(0, -1))
+    _, out_shapes, _ = r.infer_shape(data=(4, 3, 2))
+    assert out_shapes == [(4, 6)]
+    f = sym.Flatten(data=data)
+    _, out_shapes, _ = f.infer_shape(data=(4, 3, 2, 2))
+    assert out_shapes == [(4, 12)]

@@ -1,0 +1,124 @@
+"""IO tests (reference: tests/python/unittest/test_io.py — epoch determinism,
+NDArrayIter padding; datasets are synthesized since this environment has no
+network access)."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+
+
+def test_ndarray_iter_basic():
+    data = np.random.uniform(size=(100, 3)).astype(np.float32)
+    label = np.arange(100, dtype=np.float32)
+    it = mio.NDArrayIter(data, label, batch_size=10)
+    batches = list(it)
+    assert len(batches) == 10
+    for i, b in enumerate(batches):
+        np.testing.assert_allclose(b.data[0].asnumpy(), data[i * 10:(i + 1) * 10])
+        np.testing.assert_allclose(b.label[0].asnumpy(), label[i * 10:(i + 1) * 10])
+        assert b.pad == 0
+
+
+def test_ndarray_iter_padding():
+    """Reference: test_NDArrayIter — 105 samples, batch 10 -> last batch pad 5
+    wrapping to epoch start."""
+    data = np.arange(105, dtype=np.float32).reshape(105, 1)
+    it = mio.NDArrayIter(data, np.arange(105, dtype=np.float32), batch_size=10)
+    batches = list(it)
+    assert len(batches) == 11
+    assert batches[-1].pad == 5
+    last = batches[-1].data[0].asnumpy().ravel()
+    np.testing.assert_allclose(last[:5], np.arange(100, 105))
+    np.testing.assert_allclose(last[5:], np.arange(0, 5))  # wrapped
+
+
+def test_ndarray_iter_epoch_determinism():
+    data = np.random.uniform(size=(40, 2)).astype(np.float32)
+    it = mio.NDArrayIter(data, np.zeros(40, np.float32), batch_size=8)
+    e1 = [b.data[0].asnumpy() for b in it]
+    e2 = [b.data[0].asnumpy() for b in it]
+    for a, b in zip(e1, e2):
+        np.testing.assert_allclose(a, b)
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    data = np.arange(32, dtype=np.float32).reshape(32, 1)
+    it = mio.NDArrayIter(data, np.zeros(32, np.float32), batch_size=8, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(32))
+
+
+def _write_idx(path, arr):
+    """Write an idx-format file (the MNIST container format)."""
+    dtype_code = {np.uint8: 0x08, np.float32: 0x0D}[arr.dtype.type]
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, dtype_code, arr.ndim))
+        f.write(struct.pack(f">{arr.ndim}I", *arr.shape))
+        f.write(arr.tobytes())
+
+
+def test_mnist_iter(tmp_path):
+    images = (np.random.uniform(0, 255, (50, 28, 28))).astype(np.uint8)
+    labels = np.random.randint(0, 10, (50,)).astype(np.uint8)
+    img_path, lbl_path = str(tmp_path / "img.idx"), str(tmp_path / "lbl.idx")
+    _write_idx(img_path, images)
+    _write_idx(lbl_path, labels)
+
+    it = mio.MNISTIter(image=img_path, label=lbl_path, batch_size=10, flat=True)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (10, 784)
+    np.testing.assert_allclose(
+        batches[0].data[0].asnumpy(), images[:10].reshape(10, 784) / 255.0,
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), labels[:10])
+
+    it4 = mio.MNISTIter(image=img_path, label=lbl_path, batch_size=10, flat=False)
+    b = next(iter(it4))
+    assert b.data[0].shape == (10, 1, 28, 28)
+
+
+def test_mnist_iter_sharding(tmp_path):
+    images = np.arange(40 * 4, dtype=np.uint8).reshape(40, 2, 2)
+    labels = np.arange(40, dtype=np.uint8)
+    img_path, lbl_path = str(tmp_path / "i.idx"), str(tmp_path / "l.idx")
+    _write_idx(img_path, images)
+    _write_idx(lbl_path, labels)
+    part0 = mio.MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                          flat=True, num_parts=2, part_index=0)
+    part1 = mio.MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                          flat=True, num_parts=2, part_index=1)
+    l0 = np.concatenate([b.label[0].asnumpy() for b in part0])
+    l1 = np.concatenate([b.label[0].asnumpy() for b in part1])
+    assert len(l0) == 20 and len(l1) == 20
+    assert not np.allclose(l0, l1)
+
+
+def test_prefetching_iter():
+    data = np.random.uniform(size=(64, 3)).astype(np.float32)
+    base = mio.NDArrayIter(data, np.zeros(64, np.float32), batch_size=8)
+    pf = mio.PrefetchingIter(base)
+    b1 = [b.data[0].asnumpy() for b in pf]
+    assert len(b1) == 8
+    # second epoch works and matches
+    b2 = [b.data[0].asnumpy() for b in pf]
+    for a, b in zip(b1, b2):
+        np.testing.assert_allclose(a, b)
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.uniform(size=(20, 4)).astype(np.float32)
+    labels = np.arange(20, dtype=np.float32)
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, labels, delimiter=",")
+    it = mio.CSVIter(data_csv=dpath, data_shape=(4,), label_csv=lpath, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 4
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5], rtol=1e-5)

@@ -1,0 +1,166 @@
+"""KVStore tests (reference: tests/python/unittest/test_kvstore.py —
+single/list keys, aggregation over 4 fake devices, custom updater; plus the
+ported dist_sync semantics test from tests/python/multi-node/
+dist_sync_kvstore.py, run on an in-process worker group)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kv_mod
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _same(a, b, tol=1e-5):
+    np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+
+def test_single_kv_pair():
+    kv = kv_mod.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    _same(val.asnumpy(), np.ones(SHAPE))
+
+
+def test_list_kv_pair():
+    kv = kv_mod.create("local")
+    kv.init(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    val = [mx.nd.empty(SHAPE)] * len(KEYS)
+    kv.pull(KEYS, out=val)
+    for v in val:
+        _same(v.asnumpy(), np.ones(SHAPE) * 4)
+
+
+def test_aggregator():
+    """Push from 4 fake devices -> pull sees the sum (reference: test_aggregator)."""
+    kv = kv_mod.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+    num_devs = 4
+    devs = [mx.cpu(i) for i in range(num_devs)]
+    vals = [mx.nd.ones(SHAPE, d) for d in devs]
+    kv.push(3, vals)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    _same(out.asnumpy(), np.ones(SHAPE) * num_devs)
+    # list interface
+    kv.init(KEYS, [mx.nd.ones(SHAPE)] * len(KEYS))
+    kv.push(KEYS, [[mx.nd.ones(SHAPE, d) * 2.0 for d in devs]] * len(KEYS))
+    outs = [mx.nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        _same(o.asnumpy(), np.ones(SHAPE) * 2.0 * num_devs)
+
+
+def test_updater():
+    """Custom updater runs on push (reference: test_updater)."""
+    kv = kv_mod.create("local")
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv.set_updater(updater)
+    kv.init(3, mx.nd.ones(SHAPE) * 4)
+    devs = [mx.cpu(i) for i in range(4)]
+    vals = [mx.nd.ones(SHAPE, d) for d in devs]
+    kv.push(3, vals)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    _same(out.asnumpy(), np.ones(SHAPE) * 4 * 2 + 4)  # 4 + 2*sum(ones*4)
+
+
+def test_get_type():
+    assert kv_mod.create("local").type == "local"
+    assert kv_mod.create("device").type == "device"
+
+
+def test_optimizer_on_kvstore():
+    kv = kv_mod.create("local")
+    opt = mx.optimizer.create("sgd", lr=0.1, rescale_grad=1.0)
+    kv.set_optimizer(opt)
+    kv.init(0, mx.nd.ones(SHAPE))
+    kv.push(0, [mx.nd.ones(SHAPE)])
+    out = mx.nd.empty(SHAPE)
+    kv.pull(0, out=out)
+    _same(out.asnumpy(), np.ones(SHAPE) - 0.1)
+
+
+def test_dist_sync_group_semantics():
+    """Ported reference test (tests/python/multi-node/dist_sync_kvstore.py):
+    each of N workers pushes rank-dependent values; BSP semantics give the
+    closed-form reduced result on every worker."""
+    n = 4
+    stores = kv_mod.create_group(n)
+    results = {}
+    errors = []
+
+    def worker(rank):
+        try:
+            kv = stores[rank]
+            kv.init(3, mx.nd.ones(SHAPE))
+            # one BSP round: every worker pushes (rank+1) * ones
+            kv.push(3, [mx.nd.ones(SHAPE) * (rank + 1)])
+            out = mx.nd.empty(SHAPE)
+            kv.pull(3, out=out)
+            results[rank] = out.asnumpy()
+            kv.barrier()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    expected = np.ones(SHAPE) * sum(r + 1 for r in range(n))  # 1+2+3+4 = 10
+    for rank in range(n):
+        _same(results[rank], expected)
+
+
+def test_dist_sync_group_with_updater():
+    """BSP + server-side updater: update runs once per round with the
+    across-worker sum (reference: dist server accumulate-until-N then
+    updater, kvstore_dist_server.h:164-193)."""
+    n = 3
+    stores = kv_mod.create_group(n)
+
+    def updater(key, recv, stored):
+        stored += recv
+
+    stores[0].set_updater(updater)  # server-side: one updater for the group
+    results = {}
+
+    def worker(rank):
+        kv = stores[rank]
+        kv.init(9, mx.nd.zeros(SHAPE))
+        for _round in range(2):
+            kv.push(9, [mx.nd.ones(SHAPE)])
+        out = mx.nd.empty(SHAPE)
+        kv.pull(9, out=out)
+        results[rank] = out.asnumpy()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    # 2 rounds x (sum over 3 workers of ones) accumulated
+    for rank in range(n):
+        _same(results[rank], np.ones(SHAPE) * 2 * n)
+
+
+def test_dist_single_process():
+    """dist_sync with one process degenerates to local semantics."""
+    kv = kv_mod.create("dist_sync")
+    assert kv.num_workers == 1 and kv.rank == 0
+    kv.init(1, mx.nd.ones(SHAPE))
+    kv.push(1, [mx.nd.ones(SHAPE) * 3])
+    out = mx.nd.empty(SHAPE)
+    kv.pull(1, out=out)
+    _same(out.asnumpy(), np.ones(SHAPE) * 3)
+    kv.barrier()

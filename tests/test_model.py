@@ -1,0 +1,199 @@
+"""Trainer-stack tests (reference: tests/python/train/test_mlp.py — train a
+real model and assert final accuracy; dataset synthesized since there is no
+network). Also covers optimizer math, initializers, metrics, checkpointing."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _two_blob_dataset(n=400, dim=10, seed=0):
+    """Linearly separable 2-class blobs — converges in a few epochs."""
+    rng = np.random.RandomState(seed)
+    centers = rng.uniform(-2, 2, (2, dim))
+    X, y = [], []
+    for cls in range(2):
+        X.append(centers[cls] + 0.3 * rng.randn(n // 2, dim))
+        y.append(np.full(n // 2, cls))
+    X = np.concatenate(X).astype(np.float32)
+    y = np.concatenate(y).astype(np.float32)
+    order = rng.permutation(n)
+    return X[order], y[order]
+
+
+def _mlp_sym(num_classes=2):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=16)
+    net = sym.Activation(data=net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=num_classes)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_feedforward_fit_accuracy():
+    X, y = _two_blob_dataset()
+    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=8,
+                           learning_rate=0.5, optimizer="sgd", momentum=0.9)
+    # map kwargs: optimizer kwargs are passed through FeedForward(**kwargs)
+    model.kwargs = {"lr": 0.5, "momentum": 0.9}
+    model.fit(X, y, batch_size=40)
+    preds = model.predict(X, batch_size=40)
+    acc = (preds.argmax(axis=1) == y).mean()
+    assert acc > 0.95, f"accuracy {acc}"
+
+
+def test_feedforward_eval_data_and_score():
+    Xall, yall = _two_blob_dataset(n=600, seed=1)
+    X, y = Xall[:400], yall[:400]
+    Xv, yv = Xall[400:], yall[400:]
+    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=6)
+    model.kwargs = {"lr": 0.5}
+    val_iter = mx.io.NDArrayIter(Xv, yv, batch_size=40)
+    model.fit(X, y, eval_data=val_iter, batch_size=40)
+    score = model.score(mx.io.NDArrayIter(Xv, yv, batch_size=40))
+    assert score > 0.9
+
+
+def test_feedforward_checkpoint_roundtrip(tmp_path):
+    X, y = _two_blob_dataset()
+    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=3)
+    model.kwargs = {"lr": 0.5}
+    model.fit(X, y, batch_size=40)
+    p1 = model.predict(X, batch_size=40)
+    prefix = str(tmp_path / "mlp")
+    model.save(prefix, 3)
+    loaded = mx.FeedForward.load(prefix, 3, ctx=mx.cpu())
+    p2 = loaded.predict(X, batch_size=40)
+    np.testing.assert_allclose(p1, p2, rtol=1e-4, atol=1e-5)
+
+
+def test_feedforward_multi_device_dp():
+    """Data parallel over multiple virtual devices: same convergence."""
+    X, y = _two_blob_dataset()
+    model = mx.FeedForward(_mlp_sym(), ctx=[mx.cpu(i) for i in range(4)],
+                           num_epoch=6)
+    model.kwargs = {"lr": 0.5}
+    model.fit(X, y, batch_size=40, kvstore="device")
+    preds = model.predict(X, batch_size=40)
+    acc = (preds.argmax(axis=1) == y).mean()
+    assert acc > 0.95, f"multi-device accuracy {acc}"
+
+
+def test_feedforward_create():
+    X, y = _two_blob_dataset()
+    model = mx.FeedForward.create(_mlp_sym(), X, y, ctx=mx.cpu(), num_epoch=4,
+                                  lr=0.5, batch_size=40)
+    acc = (model.predict(X, batch_size=40).argmax(axis=1) == y).mean()
+    assert acc > 0.9
+
+
+def test_epoch_and_batch_callbacks():
+    X, y = _two_blob_dataset()
+    epochs, batches = [], []
+    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=2)
+    model.kwargs = {"lr": 0.1}
+    model.fit(
+        X, y, batch_size=40,
+        epoch_end_callback=lambda e, s, a, x: epochs.append(e),
+        batch_end_callback=lambda p: batches.append(p.nbatch),
+    )
+    assert epochs == [0, 1]
+    assert len(batches) == 20  # 10 batches x 2 epochs
+
+
+def test_optimizer_sgd_momentum_math():
+    opt = mx.optimizer.create("sgd", lr=0.1, momentum=0.9, rescale_grad=1.0)
+    w = mx.nd.ones((3,))
+    g = mx.nd.ones((3,))
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    np.testing.assert_allclose(w.asnumpy(), np.ones(3) - 0.1, rtol=1e-6)
+    opt.update(0, w, g, state)
+    # momentum: m1=-0.1, m2=0.9*(-0.1)-0.1=-0.19
+    np.testing.assert_allclose(w.asnumpy(), np.ones(3) - 0.1 - 0.19, rtol=1e-5)
+
+
+def test_optimizer_clip_and_wd():
+    opt = mx.optimizer.create("sgd", lr=1.0, wd=0.1, clip_gradient=0.5,
+                              rescale_grad=1.0)
+    w = mx.nd.ones((2,))
+    g = mx.nd.array(np.array([10.0, -10.0]))
+    opt.update(0, w, g, opt.create_state(0, w))
+    # clipped grad ±0.5, +wd*w=0.1 -> steps 0.6, -0.4
+    np.testing.assert_allclose(w.asnumpy(), [1 - 0.6, 1 + 0.4], rtol=1e-5)
+
+
+def test_get_updater():
+    opt = mx.optimizer.create("sgd", lr=0.1, rescale_grad=1.0)
+    updater = mx.optimizer.get_updater(opt)
+    w = mx.nd.ones((2,))
+    updater(0, mx.nd.ones((2,)), w)
+    np.testing.assert_allclose(w.asnumpy(), [0.9, 0.9], rtol=1e-6)
+
+
+def test_initializers():
+    for init, checker in [
+        (mx.init.Uniform(0.5), lambda a: (np.abs(a) <= 0.5).all()),
+        (mx.init.Normal(2.0), lambda a: 1.0 < a.std() < 3.0),
+        (mx.init.Xavier(), lambda a: a.std() > 0),
+    ]:
+        arr = mx.nd.zeros((100, 100))
+        init("fc1_weight", arr)
+        assert checker(arr.asnumpy())
+    arr = mx.nd.zeros((10,))
+    mx.init.Uniform()("fc1_bias", arr)
+    np.testing.assert_allclose(arr.asnumpy(), 0)
+    mx.init.Uniform()("bn_gamma", arr)
+    np.testing.assert_allclose(arr.asnumpy(), 1)
+    mx.init.Uniform()("bn_moving_var", arr)
+    np.testing.assert_allclose(arr.asnumpy(), 1)
+
+
+def test_metrics():
+    acc = mx.metric.create("accuracy")
+    preds = mx.nd.array(np.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]]))
+    labels = mx.nd.array(np.array([0, 1, 1]))
+    acc.update([labels], [preds])
+    assert abs(acc.get()[1] - 2.0 / 3) < 1e-6
+    mse = mx.metric.create("mse")
+    mse.update([mx.nd.array([1.0, 2.0])], [mx.nd.array([1.5, 2.5])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+    custom = mx.metric.np_metric(lambda l, p: float(np.abs(l - p).sum()))
+    custom.update([mx.nd.array([1.0])], [mx.nd.array([3.0])])
+    assert abs(custom.get()[1] - 2.0) < 1e-6
+
+
+def test_lr_scheduler():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(5) == 1.0
+    assert s(10) == 0.5
+    assert s(25) == 0.25
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert m(0) == 1.0 and abs(m(7) - 0.1) < 1e-9 and abs(m(20) - 0.01) < 1e-9
+
+
+def test_monitor():
+    X, y = _two_blob_dataset()
+    net = _mlp_sym()
+    exe = net.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    exe.arg_dict["data"][:] = X[:4]
+    exe.arg_dict["fc1_weight"][:] = np.random.uniform(-1, 1, (16, 10))
+    exe.arg_dict["fc2_weight"][:] = np.random.uniform(-1, 1, (2, 16))
+    mon = mx.Monitor(interval=1, pattern=".*fc1.*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    stats = mon.toc()
+    assert stats, "monitor collected nothing"
+    assert all("fc1" in name for _, name, _ in stats)
+
+
+def test_visualization():
+    net = _mlp_sym()
+    dot = mx.viz.plot_network(net, title="mlp")
+    assert "digraph" in dot and "fc1" in dot
+    summary = mx.viz.print_summary(net, shape={"data": (4, 10), "softmax_label": (4,)})
+    assert "Total params" in summary

@@ -1,0 +1,333 @@
+"""Operator forward/backward tests (reference:
+tests/python/unittest/test_operator.py — forward AND analytic-vs-numeric
+gradients for elementwise_sum, concat, slice_channel, regression, NumpyOp)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _same(a, b, tol=1e-4):
+    np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+
+def _numeric_grad(f, x, eps=1e-3):
+    """Central-difference gradient of scalar-valued f at x."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = x[idx]
+        x[idx] = orig + eps
+        fp = f(x)
+        x[idx] = orig - eps
+        fm = f(x)
+        x[idx] = orig
+        g[idx] = (fp - fm) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def _check_numeric_gradient(symbol, location, check_args, tol=1e-2):
+    """Bind, backward with ones cotangent, compare to numeric grad of sum(out)."""
+    exe = symbol.simple_bind(mx.cpu(), **{k: v.shape for k, v in location.items()})
+    for k, v in location.items():
+        exe.arg_dict[k][:] = v
+    exe.forward(is_train=True)
+    exe.backward()
+    for name in check_args:
+        x0 = location[name].copy()
+
+        def f(x, name=name):
+            args = dict(location)
+            args[name] = x
+            for k, v in args.items():
+                exe.arg_dict[k][:] = v
+            out = exe.forward(is_train=True)
+            return sum(float(o.asnumpy().astype(np.float64).sum()) for o in out)
+
+        expected = _numeric_grad(f, x0)
+        # restore and recompute analytic grad at the original point
+        for k, v in location.items():
+            exe.arg_dict[k][:] = v
+        exe.forward(is_train=True)
+        exe.backward()
+        got = exe.grad_dict[name].asnumpy()
+        np.testing.assert_allclose(got, expected, rtol=tol, atol=tol)
+
+
+def test_elementwise_sum():
+    shape = (5, 5)
+    n = 4
+    inputs = [sym.Variable(f"arg{i}") for i in range(n)]
+    out = sym.ElementWiseSum(*inputs, name="esum")
+    arrs = {f"arg{i}": np.random.uniform(-10, 10, shape).astype(np.float32)
+            for i in range(n)}
+    exe = out.simple_bind(mx.cpu(), **{k: shape for k in arrs})
+    for k, v in arrs.items():
+        exe.arg_dict[k][:] = v
+    (o,) = exe.forward(is_train=True)
+    _same(o.asnumpy(), sum(arrs.values()))
+    exe.backward([mx.nd.array(np.ones(shape) * 2)])
+    for i in range(n):
+        _same(exe.grad_dict[f"arg{i}"].asnumpy(), np.ones(shape) * 2)
+
+
+def test_concat_and_grad():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = sym.Concat(a, b, dim=1, name="cat")
+    av = np.random.uniform(size=(2, 3)).astype(np.float32)
+    bv = np.random.uniform(size=(2, 4)).astype(np.float32)
+    exe = out.simple_bind(mx.cpu(), a=(2, 3), b=(2, 4))
+    exe.arg_dict["a"][:] = av
+    exe.arg_dict["b"][:] = bv
+    (o,) = exe.forward(is_train=True)
+    _same(o.asnumpy(), np.concatenate([av, bv], axis=1))
+    og = np.random.uniform(size=(2, 7)).astype(np.float32)
+    exe.backward([mx.nd.array(og)])
+    _same(exe.grad_dict["a"].asnumpy(), og[:, :3])
+    _same(exe.grad_dict["b"].asnumpy(), og[:, 3:])
+
+
+def test_slice_channel():
+    data = sym.Variable("data")
+    outs = sym.SliceChannel(data=data, num_outputs=3, name="slice")
+    dv = np.random.uniform(size=(2, 6, 2)).astype(np.float32)
+    exe = outs.simple_bind(mx.cpu(), data=dv.shape)
+    exe.arg_dict["data"][:] = dv
+    result = exe.forward()
+    assert len(result) == 3
+    for i, r in enumerate(result):
+        _same(r.asnumpy(), dv[:, i * 2:(i + 1) * 2])
+
+
+def test_regression_grad():
+    for op, transform in [(sym.LinearRegressionOutput, lambda x: x),
+                          (sym.LogisticRegressionOutput,
+                           lambda x: 1 / (1 + np.exp(-x)))]:
+        data = sym.Variable("data")
+        label = sym.Variable("label")
+        out = op(data=data, label=label, name="reg")
+        dv = np.random.uniform(-1, 1, (4, 1)).astype(np.float32)
+        lv = np.random.uniform(0, 1, (4, 1)).astype(np.float32)
+        exe = out.simple_bind(mx.cpu(), data=(4, 1), label=(4, 1))
+        exe.arg_dict["data"][:] = dv
+        exe.arg_dict["label"][:] = lv
+        (o,) = exe.forward(is_train=True)
+        _same(o.asnumpy(), transform(dv), tol=1e-4)
+        exe.backward()
+        _same(exe.grad_dict["data"].asnumpy(), transform(dv) - lv, tol=1e-4)
+
+
+def test_softmax_output_grad():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    out = sym.SoftmaxOutput(data=data, label=label, name="sm")
+    dv = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    lv = np.array([0, 2, 4, 1], np.float32)
+    exe = out.simple_bind(mx.cpu(), data=(4, 5), label=(4,))
+    exe.arg_dict["data"][:] = dv
+    exe.arg_dict["label"][:] = lv
+    (o,) = exe.forward(is_train=True)
+    e = np.exp(dv - dv.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    _same(o.asnumpy(), p, tol=1e-4)
+    exe.backward()
+    onehot = np.zeros((4, 5), np.float32)
+    onehot[np.arange(4), lv.astype(int)] = 1
+    _same(exe.grad_dict["data"].asnumpy(), p - onehot, tol=1e-4)
+
+
+def test_fullyconnected_numeric_grad():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data=data, name="fc", num_hidden=4)
+    loc = {
+        "data": np.random.uniform(-1, 1, (3, 5)).astype(np.float32),
+        "fc_weight": np.random.uniform(-1, 1, (4, 5)).astype(np.float32),
+        "fc_bias": np.random.uniform(-1, 1, (4,)).astype(np.float32),
+    }
+    _check_numeric_gradient(out, loc, ["data", "fc_weight", "fc_bias"])
+
+
+def test_convolution_forward_vs_numpy():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data=data, name="c", kernel=(3, 3), num_filter=2,
+                           stride=(2, 2), pad=(1, 1))
+    dv = np.random.uniform(-1, 1, (1, 3, 7, 7)).astype(np.float32)
+    wv = np.random.uniform(-1, 1, (2, 3, 3, 3)).astype(np.float32)
+    bv = np.random.uniform(-1, 1, (2,)).astype(np.float32)
+    exe = conv.simple_bind(mx.cpu(), data=dv.shape)
+    exe.arg_dict["data"][:] = dv
+    exe.arg_dict["c_weight"][:] = wv
+    exe.arg_dict["c_bias"][:] = bv
+    (o,) = exe.forward()
+    # direct convolution reference
+    padded = np.pad(dv, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    expect = np.zeros(o.shape, np.float32)
+    for f in range(2):
+        for i in range(o.shape[2]):
+            for j in range(o.shape[3]):
+                patch = padded[0, :, i * 2:i * 2 + 3, j * 2:j * 2 + 3]
+                expect[0, f, i, j] = (patch * wv[f]).sum() + bv[f]
+    _same(o.asnumpy(), expect, tol=1e-3)
+
+
+def test_convolution_numeric_grad():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data=data, name="c", kernel=(2, 2), num_filter=2)
+    loc = {
+        "data": np.random.uniform(-1, 1, (2, 2, 4, 4)).astype(np.float32),
+        "c_weight": np.random.uniform(-1, 1, (2, 2, 2, 2)).astype(np.float32),
+        "c_bias": np.random.uniform(-1, 1, (2,)).astype(np.float32),
+    }
+    _check_numeric_gradient(conv, loc, ["data", "c_weight"])
+
+
+def test_pooling_forward():
+    data = sym.Variable("data")
+    dv = np.random.uniform(-1, 1, (1, 2, 4, 4)).astype(np.float32)
+    for pool_type, npf in [("max", np.max), ("avg", np.mean), ("sum", np.sum)]:
+        p = sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2), pool_type=pool_type)
+        exe = p.simple_bind(mx.cpu(), data=dv.shape)
+        exe.arg_dict["data"][:] = dv
+        (o,) = exe.forward()
+        expect = np.zeros((1, 2, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                expect[:, :, i, j] = npf(dv[:, :, i * 2:i * 2 + 2, j * 2:j * 2 + 2],
+                                         axis=(2, 3))
+        _same(o.asnumpy(), expect, tol=1e-5)
+
+
+def test_activation_grads():
+    data = sym.Variable("data")
+    dv = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    for act in ["relu", "sigmoid", "tanh", "softrelu"]:
+        net = sym.Activation(data=data, act_type=act)
+        _check_numeric_gradient(net, {"data": dv.copy()}, ["data"])
+
+
+def test_batchnorm_train_eval():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, name="bn", momentum=0.5)
+    dv = np.random.uniform(-2, 2, (8, 3)).astype(np.float32)
+    exe = bn.simple_bind(mx.cpu(), data=dv.shape)
+    exe.arg_dict["data"][:] = dv
+    exe.arg_dict["bn_gamma"][:] = 1.0
+    exe.arg_dict["bn_beta"][:] = 0.0
+    exe.aux_dict["bn_moving_var"][:] = 1.0
+    (o,) = exe.forward(is_train=True)
+    expect = (dv - dv.mean(0)) / np.sqrt(dv.var(0) + 1e-3)
+    _same(o.asnumpy(), expect, tol=1e-3)
+    # moving stats updated: mean momentum 0.5
+    _same(exe.aux_dict["bn_moving_mean"].asnumpy(), 0.5 * dv.mean(0), tol=1e-4)
+    # eval mode uses moving stats
+    (o2,) = exe.forward(is_train=False)
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    mv = exe.aux_dict["bn_moving_var"].asnumpy()
+    _same(o2.asnumpy(), (dv - mm) / np.sqrt(mv + 1e-3), tol=1e-3)
+
+
+def test_dropout():
+    data = sym.Variable("data")
+    net = sym.Dropout(data=data, p=0.5)
+    dv = np.ones((200, 200), np.float32)
+    exe = net.simple_bind(mx.cpu(), data=dv.shape)
+    exe.arg_dict["data"][:] = dv
+    (o,) = exe.forward(is_train=True)
+    out = o.asnumpy()
+    frac_kept = (out > 0).mean()
+    assert 0.45 < frac_kept < 0.55
+    _same(out[out > 0], np.full((out > 0).sum(), 2.0))  # inverted scaling
+    (o_eval,) = exe.forward(is_train=False)
+    _same(o_eval.asnumpy(), dv)
+
+
+def test_leakyrelu():
+    data = sym.Variable("data")
+    net = sym.LeakyReLU(data=data, act_type="leaky", slope=0.1)
+    dv = np.array([[-1.0, 2.0], [-3.0, 4.0]], np.float32)
+    exe = net.simple_bind(mx.cpu(), data=dv.shape)
+    exe.arg_dict["data"][:] = dv
+    (o,) = exe.forward()
+    _same(o.asnumpy(), np.where(dv > 0, dv, 0.1 * dv))
+
+
+def test_blockgrad():
+    data = sym.Variable("data")
+    net = sym.BlockGrad(data=data)
+    dv = np.random.uniform(size=(3, 3)).astype(np.float32)
+    exe = net.simple_bind(mx.cpu(), data=dv.shape)
+    exe.arg_dict["data"][:] = dv
+    (o,) = exe.forward(is_train=True)
+    _same(o.asnumpy(), dv)
+    exe.backward()
+    _same(exe.grad_dict["data"].asnumpy(), np.zeros_like(dv))
+
+
+def test_embedding():
+    data = sym.Variable("data")
+    net = sym.Embedding(data=data, input_dim=10, output_dim=4, name="emb")
+    ids = np.array([[1, 2], [3, 4]], np.float32)
+    exe = net.simple_bind(mx.cpu(), data=ids.shape)
+    exe.arg_dict["data"][:] = ids
+    wv = np.random.uniform(size=(10, 4)).astype(np.float32)
+    exe.arg_dict["emb_weight"][:] = wv
+    (o,) = exe.forward()
+    _same(o.asnumpy(), wv[ids.astype(int)])
+
+
+def test_numpy_op():
+    """NumpyOp custom softmax (reference: test_operator.py check_softmax
+    via the python NumpyOp bridge)."""
+
+    class NumpySoftmax(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            data_shape = in_shape[0]
+            label_shape = (in_shape[0][0],)
+            return [data_shape, label_shape], [data_shape]
+
+        def forward(self, in_data, out_data):
+            x = in_data[0]
+            y = out_data[0]
+            y[:] = np.exp(x - x.max(axis=1, keepdims=True))
+            y /= y.sum(axis=1, keepdims=True)
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            l = in_data[1].astype(int)
+            y = out_data[0]
+            dx = in_grad[0]
+            dx[:] = y
+            dx[np.arange(l.shape[0]), l] -= 1.0
+
+        def need_top_grad_(self):
+            return False
+
+    npsm = NumpySoftmax()
+    data = sym.Variable("data")
+    net = npsm(data=data, name="nps")
+    dv = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    lv = np.array([0, 1, 2, 3], np.float32)
+    exe = net.simple_bind(mx.cpu(), data=(4, 5), nps_label=(4,))
+    exe.arg_dict["data"][:] = dv
+    exe.arg_dict["nps_label"][:] = lv
+    (o,) = exe.forward(is_train=True)
+    e = np.exp(dv - dv.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    _same(o.asnumpy(), p, tol=1e-4)
+    exe.backward()
+    onehot = np.zeros((4, 5), np.float32)
+    onehot[np.arange(4), lv.astype(int)] = 1
+    _same(exe.grad_dict["data"].asnumpy(), p - onehot, tol=1e-4)

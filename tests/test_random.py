@@ -1,0 +1,51 @@
+"""PRNG tests (reference: tests/python/unittest/test_random.py — moment
+checks + seed determinism)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_uniform_moments():
+    a, b = -10, 10
+    shape = (100, 100)
+    mx.random.seed(128)
+    ret1 = mx.random.uniform(a, b, shape)
+    mx.random.seed(128)
+    ret2 = mx.random.uniform(a, b, shape)
+    assert np.allclose(ret1.asnumpy(), ret2.asnumpy())
+    un1 = ret1.asnumpy()
+    assert abs(un1.mean() - (a + b) / 2) < 0.1
+    assert un1.min() >= a and un1.max() < b
+
+
+def test_normal_moments():
+    mu, sigma = 10.0, 2.0
+    shape = (100, 100)
+    mx.random.seed(42)
+    ret1 = mx.random.normal(mu, sigma, shape)
+    mx.random.seed(42)
+    ret2 = mx.random.normal(mu, sigma, shape)
+    assert np.allclose(ret1.asnumpy(), ret2.asnumpy())
+    arr = ret1.asnumpy()
+    assert abs(arr.mean() - mu) < 0.1
+    assert abs(arr.std() - sigma) < 0.1
+
+
+def test_uniform_out_param():
+    out = mx.nd.zeros((50, 50))
+    mx.random.uniform(0, 1, out=out)
+    arr = out.asnumpy()
+    assert arr.min() >= 0 and arr.max() < 1
+    assert arr.std() > 0
+
+
+def test_different_draws():
+    a = mx.random.uniform(0, 1, (10,)).asnumpy()
+    b = mx.random.uniform(0, 1, (10,)).asnumpy()
+    assert not np.allclose(a, b)
+
+
+def test_randint():
+    r = mx.random.randint(0, 5, (1000,)).asnumpy()
+    assert r.min() >= 0 and r.max() < 5

@@ -1,0 +1,89 @@
+"""Symbol tests (reference: tests/python/unittest/test_symbol.py —
+compose/internals/pickle/saveload)."""
+
+import pickle
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net = sym.Activation(data=net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=5)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_symbol_compose():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label",
+    ]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_symbol_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_outputs() == ["fc1_output"]
+
+
+def test_symbol_group():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    fc2 = sym.FullyConnected(data=data, name="fc2", num_hidden=10)
+    g = sym.Group([fc1, fc2])
+    assert g.list_outputs() == ["fc1_output", "fc2_output"]
+    assert len(g) == 2
+
+
+def test_symbol_pickle():
+    net = _mlp()
+    s = pickle.dumps(net)
+    net2 = pickle.loads(s)
+    assert net.tojson() == net2.tojson()
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_symbol_saveload(tmp_path):
+    fname = str(tmp_path / "net.json")
+    net = _mlp()
+    net.save(fname)
+    net2 = sym.load(fname)
+    assert net.tojson() == net2.tojson()
+
+
+def test_symbol_arithmetic():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    assert set(c.list_arguments()) == {"a", "b"}
+    d = (a * b) / (a - b)
+    assert set(d.list_arguments()) == {"a", "b"}
+
+
+def test_symbol_auto_names():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=3)
+    assert fc.name.startswith("fullyconnected")
+
+
+def test_symbol_variable_arity():
+    xs = [sym.Variable(f"x{i}") for i in range(4)]
+    c = sym.Concat(*xs, dim=1, name="cat")
+    assert c.list_arguments() == [f"x{i}" for i in range(4)]
+    s = sym.ElementWiseSum(*xs, name="esum")
+    assert len(s.list_arguments()) == 4
+
+
+def test_symbol_unknown_input_rejected():
+    data = sym.Variable("data")
+    with pytest.raises(mx.MXNetError):
+        sym.FullyConnected(bogus=data, num_hidden=3)
