@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""CIFAR-10 Inception-BN training (reference: example/cifar10/cifar10.py,
+the 'local' kvstore baseline config in BASELINE.md).
+
+Data: a RecordIO file packed by tools/im2rec.py (--data-rec), or synthetic
+CIFAR-shaped JPEG records generated on the fly (default, offline-safe).
+
+  python examples/cifar10/train_cifar10.py --num-epochs 2
+"""
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def make_synthetic_rec(path, n=2048, num_classes=10, seed=0):
+    from mxnet_tpu import recordio as rio
+
+    rng = np.random.RandomState(seed)
+    protos = rng.randint(0, 255, (num_classes, 32, 32, 3), np.uint8)
+    w = rio.MXRecordIO(path, "w")
+    for i in range(n):
+        cls = i % num_classes
+        noise = rng.randint(-30, 30, (32, 32, 3), np.int16)
+        img = np.clip(protos[cls].astype(np.int16) + noise, 0, 255).astype(np.uint8)
+        w.write(rio.pack_img(rio.IRHeader(0, float(cls), i, 0), img,
+                             img_fmt=".jpg"))
+    w.close()
+    return path
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-rec", default=None)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--num-devices", type=int, default=1)
+    ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import inception_bn_cifar
+
+    logging.basicConfig(level=logging.INFO)
+    rec = args.data_rec
+    if rec is None:
+        rec = os.path.join(tempfile.gettempdir(), "cifar_synth.rec")
+        if not os.path.exists(rec):
+            logging.info("generating synthetic CIFAR rec at %s", rec)
+            make_synthetic_rec(rec)
+
+    train = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 28, 28), batch_size=args.batch_size,
+        rand_crop=True, rand_mirror=True, shuffle=True,
+        mean_r=128, mean_g=128, mean_b=128, scale=1 / 128.0)
+
+    net = inception_bn_cifar()
+    ctx = [mx.tpu(i) for i in range(args.num_devices)]
+    model = mx.FeedForward(
+        net, ctx=ctx, num_epoch=args.num_epochs,
+        initializer=mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2),
+        compute_dtype=jnp.bfloat16 if args.bf16 else None,
+        lr=args.lr, momentum=0.9, wd=1e-4)
+    model.fit(train, kvstore=args.kv_store,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+
+
+if __name__ == "__main__":
+    main()

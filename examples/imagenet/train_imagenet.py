@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""ImageNet-scale training (reference: example/imagenet/ — AlexNet and
+Inception-BN with ImageRecordIter; here plus ResNet-50, the BASELINE.json
+north-star model).
+
+Data: RecordIO shards from tools/im2rec.py (--data-rec), or synthetic
+224x224 JPEG records (default). Multi-device data parallelism via
+--num-devices (kvstore 'device' ≙ ICI allreduce inside the fused step);
+multi-host via --kv-store dist_sync under tools/launch.py.
+
+  python examples/imagenet/train_imagenet.py --network resnet-50 --bf16
+"""
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def make_synthetic_rec(path, n=512, num_classes=100, size=256, seed=0):
+    from mxnet_tpu import recordio as rio
+
+    rng = np.random.RandomState(seed)
+    w = rio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), np.uint8)
+        w.write(rio.pack_img(rio.IRHeader(0, float(i % num_classes), i, 0),
+                             img, img_fmt=".jpg", quality=85))
+    w.close()
+    return path
+
+
+NETWORKS = {
+    "alexnet": lambda n: __import__("mxnet_tpu.models", fromlist=["alexnet"]).alexnet(n),
+    "inception-bn": lambda n: __import__("mxnet_tpu.models", fromlist=["inception_bn"]).inception_bn(n),
+    "resnet-50": lambda n: __import__("mxnet_tpu.models", fromlist=["resnet50"]).resnet50(n),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", choices=sorted(NETWORKS), default="resnet-50")
+    ap.add_argument("--data-rec", default=None)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--num-devices", type=int, default=1)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+
+    logging.basicConfig(level=logging.INFO)
+    rec = args.data_rec
+    if rec is None:
+        args.num_classes = 100
+        rec = os.path.join(tempfile.gettempdir(), "imagenet_synth.rec")
+        if not os.path.exists(rec):
+            logging.info("generating synthetic ImageNet rec at %s", rec)
+            make_synthetic_rec(rec)
+
+    train = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 224, 224), batch_size=args.batch_size,
+        rand_crop=True, rand_mirror=True, shuffle=True, resize=256,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94, scale=1 / 58.8)
+
+    net = NETWORKS[args.network](args.num_classes)
+    ctx = [mx.tpu(i) for i in range(args.num_devices)]
+    model = mx.FeedForward(
+        net, ctx=ctx, num_epoch=args.num_epochs,
+        initializer=mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2),
+        compute_dtype=jnp.bfloat16 if args.bf16 else None,
+        lr=args.lr, momentum=0.9, wd=1e-4)
+    model.fit(train, kvstore=args.kv_store,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size, 10),
+              epoch_end_callback=mx.callback.do_checkpoint(
+                  os.path.join(tempfile.gettempdir(), args.network)))
+
+
+if __name__ == "__main__":
+    main()

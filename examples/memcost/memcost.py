@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Memory-plan introspection (reference: example/memcost — prints 'Total N MB
+allocated' for inception-bn b32 under different memory strategies via
+GraphExecutor::Print).
+
+On TPU the strategies map to compiler features instead of executor flags:
+  no_optimization   -> eval-shape accounting of every intermediate (upper bound)
+  inplace+sharing   -> XLA buffer assignment (what actually allocates)
+  forward_only      -> inference-only program
+  + remat           -> jax.checkpoint on the loss (activation memory traded
+                       for recompute; the note_memory.md tradeoff, compiler-made)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu.executor import _build_graph_fn
+from mxnet_tpu.models import inception_bn_cifar
+
+
+def mb(x):
+    return x / (1 << 20)
+
+
+def main():
+    batch = 32
+    sym = inception_bn_cifar()
+    shapes = {"data": (batch, 3, 28, 28), "softmax_label": (batch,)}
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    arg_names, aux_names = sym.list_arguments(), sym.list_auxiliary_states()
+    args = {n: jnp.zeros(s, jnp.float32) for n, s in zip(arg_names, arg_shapes)}
+    aux = {n: jnp.zeros(s, jnp.float32) for n, s in zip(aux_names, aux_shapes)}
+    key = jnp.zeros((2,), jnp.uint32)
+
+    # upper bound: every intermediate held live (≙ no_optimization)
+    internals = sym.get_internals()
+    fn_all = _build_graph_fn(internals, is_train=False)
+    outs = jax.eval_shape(lambda a, x: fn_all(a, x, key)[0], args, aux)
+    naive = sum(int(np.prod(o.shape)) * 4 for o in outs)
+    print(f"no_optimization (sum of all intermediates): {mb(naive):8.2f} MB")
+
+    def report(tag, fn):
+        compiled = jax.jit(fn).lower(args, aux).compile()
+        try:
+            m = compiled.memory_analysis()
+            total = m.temp_size_in_bytes + m.output_size_in_bytes
+            print(f"{tag:45s}: {mb(total):8.2f} MB "
+                  f"(temp {mb(m.temp_size_in_bytes):.2f})")
+        except Exception:
+            print(f"{tag:45s}: memory analysis unavailable on this backend")
+
+    fwd = _build_graph_fn(sym, is_train=False)
+    report("forward_only (XLA buffer assignment)",
+           lambda a, x: fwd(a, x, key)[0])
+
+    fwd_t = _build_graph_fn(sym, is_train=True)
+
+    def train_loss(a, x):
+        outs, _ = fwd_t(a, x, key)
+        return jnp.sum(outs[0])
+
+    report("inplace+sharing train fwd+bwd (jax.grad)",
+           lambda a, x: jax.grad(train_loss)(a, x))
+    report("train fwd+bwd with remat (jax.checkpoint)",
+           lambda a, x: jax.grad(jax.checkpoint(train_loss))(a, x))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""MNIST training (reference: example/mnist/{mlp.py,lenet.py,train_mnist.py}).
+
+Runs on real MNIST idx files (--data-dir with train-images-idx3-ubyte etc.,
+gzip ok) or, by default in this offline environment, on a synthetic
+MNIST-shaped dataset that converges the same way.
+
+  python examples/mnist/train_mnist.py --network mlp
+  python examples/mnist/train_mnist.py --network lenet --lr 0.05
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def synthetic_mnist(n=2048, num_classes=10, seed=0):
+    """Digit-like data: each class is a fixed random stroke pattern + noise."""
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(num_classes, 28, 28) > 0.8
+    X = np.zeros((n, 28, 28), np.float32)
+    y = np.zeros((n,), np.float32)
+    for i in range(n):
+        cls = i % num_classes
+        X[i] = protos[cls] * (0.7 + 0.3 * rng.rand()) + 0.1 * rng.rand(28, 28)
+        y[i] = cls
+    order = rng.permutation(n)
+    return X[order], y[order]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", choices=["mlp", "lenet"], default="mlp")
+    ap.add_argument("--data-dir", default=None, help="dir with MNIST idx files")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--num-devices", type=int, default=1)
+    ap.add_argument("--cpu", action="store_true", help="force CPU platform")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import lenet, mlp
+
+    logging.basicConfig(level=logging.INFO)
+    flat = args.network == "mlp"
+    net = mlp() if flat else lenet()
+
+    if args.data_dir:
+        train = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "train-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True, flat=flat)
+        val = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size, flat=flat)
+    else:
+        logging.info("no --data-dir; using synthetic MNIST-shaped data")
+        X, y = synthetic_mnist()
+        X = X.reshape(len(X), -1) if flat else X[:, None]
+        split = int(0.9 * len(X))
+        train = mx.io.NDArrayIter(X[:split], y[:split],
+                                  batch_size=args.batch_size, shuffle=True)
+        val = mx.io.NDArrayIter(X[split:], y[split:], batch_size=args.batch_size)
+
+    ctx = [mx.tpu(i) for i in range(args.num_devices)]
+    model = mx.FeedForward(net, ctx=ctx, num_epoch=args.num_epochs,
+                           initializer=mx.init.Xavier(),
+                           lr=args.lr, momentum=args.momentum)
+    model.fit(train, eval_data=val, kvstore=args.kv_store,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    print("final val accuracy:", model.score(val))
+
+
+if __name__ == "__main__":
+    main()

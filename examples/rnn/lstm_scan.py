@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Scan-based LSTM language model — the TPU fast path for the same model as
+lstm_ptb.py (SURVEY.md §5: the reference's only sequence story is full graph
+unrolling; `lax.scan` compiles the recurrence once regardless of sequence
+length, so there is no per-seq-len bind and no bucketing executor).
+
+  python examples/rnn/lstm_scan.py --seq-len 64 --cpu
+"""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-hidden", type=int, default=128)
+    ap.add_argument("--num-embed", type=int, default=64)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=0.5)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+
+    from mxnet_tpu.models.lstm_scan import LSTMLM
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from lstm_ptb import synthetic_text
+
+    logging.basicConfig(level=logging.INFO)
+    vocab = 32
+    stream = synthetic_text(n_chars=100000, vocab=vocab)
+
+    model = LSTMLM(vocab=vocab, num_embed=args.num_embed,
+                   num_hidden=args.num_hidden, num_layers=args.num_layers)
+    params = model.init_params(jax.random.PRNGKey(0))
+    states = model.init_optimizer(params)
+    step = model.make_train_step(lr=args.lr, clip=5.0)
+
+    seq, bs = args.seq_len, args.batch_size
+    usable = (len(stream) - 1) // (seq * bs) * (seq * bs)
+    data = stream[:usable].reshape(bs, -1, seq).transpose(1, 0, 2).astype(np.int32)
+    labels = stream[1:usable + 1].reshape(bs, -1, seq).transpose(1, 0, 2).astype(np.int32)
+
+    tic = time.time()
+    n = min(args.steps, data.shape[0])
+    for i in range(n):
+        params, states, loss = step(params, states, data[i], labels[i])
+        if i % 20 == 0:
+            logging.info("step %d ppl=%.2f", i, float(np.exp(loss)))
+    final = float(np.exp(loss))
+    dt = time.time() - tic
+    logging.info("final perplexity=%.2f  |  %.0f tokens/sec",
+                 final, n * bs * seq / dt)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,10 @@ from . import initializer
 from . import io
 from . import kvstore as kv
 from . import kvstore
+# import-time role switch: a process with DMLC_ROLE=server/scheduler retires
+# here (reference: kvstore_server.py:48-58 runs the server loop inside
+# `import mxnet`; on TPU there is no server loop to run)
+from . import kvstore_server
 from . import metric
 from . import optimizer
 from . import callback
@@ -48,5 +52,7 @@ from . import recordio
 from . import parallel
 from . import models
 from . import utils
+from . import predictor as _predictor_mod
+from .predictor import Predictor
 
 __version__ = "0.1.0"

@@ -85,8 +85,12 @@ class NDArrayIter(DataIter):
     ``pad`` = number of wrapped samples (python/mxnet/io.py:89-194)."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
-                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label", seed=None):
         super().__init__()
+        # private RNG when seeded, so iterator construction never mutates the
+        # caller's global numpy RNG state
+        self._rng = np.random.RandomState(seed) if seed is not None else np.random
         self.data = self._to_np(data)
         n = self.data.shape[0]
         self.label = self._to_np(label) if label is not None else np.zeros((n,), np.float32)
@@ -111,7 +115,7 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         if self.shuffle:
-            np.random.shuffle(self._order)
+            self._rng.shuffle(self._order)
         self.cursor = -self.batch_size
 
     def iter_next(self):
@@ -188,11 +192,9 @@ class MNISTIter(DataIter):
             images = images.reshape(images.shape[0], 1, images.shape[1], images.shape[2])
             if input_shape is not None and tuple(input_shape) != images.shape[1:]:
                 images = images.reshape((images.shape[0],) + tuple(input_shape))
-        if shuffle:
-            # seed BEFORE the inner iterator shuffles its first epoch, so
-            # `seed` actually makes epoch order reproducible
-            np.random.seed(seed)
-        self._inner = NDArrayIter(images, labels, batch_size=batch_size, shuffle=shuffle)
+        self._inner = NDArrayIter(images, labels, batch_size=batch_size,
+                                  shuffle=shuffle,
+                                  seed=seed if shuffle else None)
         self.batch_size = batch_size
 
     def reset(self):
@@ -251,16 +253,17 @@ class ImageRecordIter(DataIter):
         elif mean_r or mean_g or mean_b:
             self._mean = np.array([mean_r, mean_g, mean_b], np.float32).reshape(3, 1, 1)
 
-        # read record offsets once; shard for this worker
-        offsets = []
-        reader = rio.MXRecordIO(path_imgrec, "r")
-        while True:
-            pos = reader.tell()
-            rec = reader.read()
-            if rec is None:
-                break
-            offsets.append(pos)
-        reader.close()
+        # read record offsets once (native header-seek scan when built, else
+        # the python seek scan — neither reads payloads); shard per worker
+        offsets = None
+        try:
+            from .. import native as native_mod
+
+            offsets = native_mod.scan_offsets(path_imgrec)
+        except Exception:
+            offsets = None
+        if offsets is None:
+            offsets = rio.scan_offsets(path_imgrec)
         per = len(offsets) // num_parts
         lo = per * part_index
         hi = per * (part_index + 1) if part_index < num_parts - 1 else len(offsets)
@@ -268,11 +271,68 @@ class ImageRecordIter(DataIter):
         if not self._offsets:
             raise MXNetError(f"no records in shard {part_index}/{num_parts}")
         self._path = path_imgrec
-        self._reader = rio.MXRecordIO(path_imgrec, "r")
         self._prefetch_depth = max(1, min(int(prefetch_buffer), 16))
+        self._pad = 0
+
+        # Prefer the native C++ pipeline (RecordIO + libjpeg decode + augment
+        # in worker threads, mxnet_tpu/native) when the records are JPEG and
+        # no full mean image is configured; fall back to the Python/PIL path
+        # otherwise. Controlled by MXNET_TPU_NATIVE_IO (default on).
+        self._native = None
+        self._native_first = None
+        use_native = (env_int("MXNET_TPU_NATIVE_IO", 1) and self._mean_is_rgb()
+                      and self._records_look_jpeg())
+        if use_native:
+            try:
+                from .. import native as native_mod
+
+                pipe = native_mod.NativePipeline(
+                    path_imgrec, self._offsets, batch_size, self.data_shape,
+                    label_width=label_width, rand_crop=rand_crop,
+                    rand_mirror=rand_mirror, resize=resize,
+                    mean=(self._mean.ravel() if self._mean is not None else None),
+                    scale=scale, shuffle=shuffle, seed=seed,
+                    prefetch=self._prefetch_depth, round_batch=round_batch)
+                # probe one batch: raises on undecodable payloads
+                self._native_first = pipe.next()
+                self._native = pipe
+            except Exception:  # missing toolchain, odd records, ...
+                self._native = None
+                self._native_first = None
         self.reset()
 
+    def _mean_is_rgb(self):
+        return self._mean is None or self._mean.size == 3
+
+    def _records_look_jpeg(self, sample=16):
+        """Cheap pre-check: peek the image magic of evenly-spaced records so a
+        mixed-format file (e.g. PNG past the first batch) never takes the
+        JPEG-only native path and dies mid-epoch."""
+        import struct as _struct
+
+        n = len(self._offsets)
+        idxs = range(n) if n <= sample else \
+            [int(i * (n - 1) / (sample - 1)) for i in range(sample)]
+        try:
+            with open(self._path, "rb") as f:
+                for i in idxs:
+                    f.seek(self._offsets[i] + 16)  # past the record header
+                    flag = _struct.unpack("<I", f.read(4))[0]
+                    # IRHeader is 24 bytes; flag>0 adds a label vector
+                    skip = 20 + (flag * 4 if flag > 0 else 0)
+                    f.seek(skip, 1)
+                    if f.read(2) != b"\xff\xd8":  # JPEG SOI
+                        return False
+        except Exception:
+            return False
+        return True
+
     def reset(self):
+        self._pad = 0
+        if self._native is not None:
+            if self._native_first is None:  # keep the probe batch on 1st epoch
+                self._native.reset()
+            return
         self._order = np.arange(len(self._offsets))
         if self.shuffle:
             self._rng.shuffle(self._order)
@@ -363,6 +423,14 @@ class ImageRecordIter(DataIter):
         self._pending.append(engine().push(produce))
 
     def next(self):
+        if self._native is not None:
+            if self._native_first is not None:
+                data, labels, pad = self._native_first
+                self._native_first = None
+            else:
+                data, labels, pad = self._native.next()  # raises StopIteration
+            self._pad = pad
+            return DataBatch([array(data)], [array(labels)], pad=pad)
         if not self._pending:
             raise StopIteration
         fut = self._pending.pop(0)

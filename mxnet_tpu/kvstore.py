@@ -191,6 +191,18 @@ class _DistKVStore(KVStore):
             multihost_utils.sync_global_devices("kvstore-barrier")
 
 
+def wrap_np_updater(updater):
+    """Adapt an NDArray updater(key, merged, weight) to the numpy buffers a
+    server holds (shared by _GroupWorkerKVStore and kvstore_server)."""
+
+    def np_updater(key, merged, stored):
+        w = NDArray(stored)
+        updater(key, NDArray(merged), w)
+        stored[...] = w.asnumpy()
+
+    return np_updater
+
+
 class _GroupServer:
     """In-process BSP server for emulated multi-worker groups: accumulates
     pushes per key until all workers arrived, runs the updater once, then
@@ -293,14 +305,7 @@ class _GroupWorkerKVStore(KVStore):
     def set_updater(self, updater):
         """The updater runs server-side on numpy buffers, mirroring the
         reference's run-updater-on-server contract."""
-
-        def np_updater(key, merged, stored):
-            w = NDArray(stored)
-            np_merged = NDArray(merged)
-            updater(key, np_merged, w)
-            stored[...] = w.asnumpy()
-
-        self._server.updater = np_updater
+        self._server.updater = wrap_np_updater(updater)
 
     def barrier(self):
         self._server.barrier()

@@ -1,0 +1,73 @@
+"""KVStore server role (reference: python/mxnet/kvstore_server.py — the
+import-time role switch where a process with DMLC_ROLE != worker creates a
+dist store, runs the server loop and exits inside ``import mxnet``).
+
+TPU-native reality: synchronous data parallelism over ICI/DCN has no server
+role — the accumulate-at-server step became an allreduce inside the training
+program (SURVEY.md §2.4). This module keeps the surface for scripts that
+launch reference-style jobs:
+
+  - ``KVStoreServer`` wraps the in-process BSP server used by emulated
+    worker groups (kvstore.create_group) and accepts the pickled-optimizer
+    command transport the reference sends (kvstore.py:231-256).
+  - ``_init_srv_role`` reproduces the import-time switch: under
+    DMLC_ROLE=server/scheduler it logs that server roles are obsolete on TPU
+    and exits cleanly, so reference launcher scripts (tracker spawning n
+    workers + s servers) still work — the server processes just retire
+    immediately instead of serving.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import sys
+
+from .kvstore import _GroupServer
+
+__all__ = ["KVStoreServer"]
+
+
+class KVStoreServer:
+    """Controller around an in-process BSP server (reference:
+    KVStoreServer._controller handling kSyncMode/kStopServer/optimizer)."""
+
+    def __init__(self, server: _GroupServer):
+        self.server = server
+        self.sync_mode = True
+        self._stopped = False
+
+    def handle_command(self, head: int, body):
+        """Reference command protocol: 0 = install pickled optimizer,
+        kStopServer(-2)/kSyncMode(-3) control (kvstore_dist_server.h:22-23)."""
+        if head == 0:
+            from .kvstore import wrap_np_updater
+            from .optimizer import get_updater
+
+            optimizer = pickle.loads(body) if isinstance(body, (bytes, bytearray)) \
+                else body
+            self.server.updater = wrap_np_updater(get_updater(optimizer))
+        elif head == -2:  # kStopServer
+            self._stopped = True
+        elif head == -3:  # kSyncMode
+            self.sync_mode = True
+
+    def run(self):
+        """The reference blocks here until kStopServer; our server is
+        passive (workers drive it), so run() is a no-op wait."""
+        return
+
+
+def _init_srv_role():
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        logging.warning(
+            "DMLC_ROLE=%s: parameter-server roles are obsolete on TPU "
+            "(sync allreduce replaces accumulate-at-server); exiting cleanly.",
+            role,
+        )
+        sys.exit(0)
+
+
+_init_srv_role()

@@ -9,8 +9,9 @@ from .alexnet import alexnet
 from .inception import inception_bn_cifar, inception_bn
 from .resnet import resnet, resnet50
 from .lstm import lstm_unroll, LSTMState, LSTMParam
+from .lstm_scan import LSTMLM
 from .transformer import TransformerLM, transformer_lm_config
 
 __all__ = ["mlp", "lenet", "alexnet", "inception_bn_cifar", "inception_bn",
            "resnet", "resnet50", "lstm_unroll", "LSTMState", "LSTMParam",
-           "TransformerLM", "transformer_lm_config"]
+           "LSTMLM", "TransformerLM", "transformer_lm_config"]

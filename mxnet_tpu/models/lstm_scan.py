@@ -1,0 +1,105 @@
+"""Scan-based LSTM language model — the TPU-native recurrence fast path.
+
+Reference counterpart: example/rnn/lstm.py unrolls seq_len x num_layers cell
+graphs (SURVEY.md §5); here the same cell math runs under ``lax.scan``, so
+one compiled program serves any sequence length of the same shape bucket and
+activation memory is handled by XLA (plus optional ``jax.checkpoint``).
+Weights follow the unrolled symbol's naming (l{i}_i2h_*/l{i}_h2h_*,
+embed_weight, cls_*) so checkpoints interchange with lstm_unroll.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["LSTMLM"]
+
+
+class LSTMLM:
+    def __init__(self, vocab, num_embed=64, num_hidden=128, num_layers=2,
+                 dtype=jnp.float32):
+        self.vocab = vocab
+        self.num_embed = num_embed
+        self.num_hidden = num_hidden
+        self.num_layers = num_layers
+        self.dtype = dtype
+
+    def init_params(self, key):
+        h, e, v = self.num_hidden, self.num_embed, self.vocab
+        keys = jax.random.split(key, 2 + 2 * self.num_layers)
+        ki = iter(keys)
+
+        def mat(key, shape):
+            scale = 1.0 / np.sqrt(shape[-1])
+            return jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+
+        params = {"embed_weight": mat(next(ki), (v, e)),
+                  "cls_weight": mat(next(ki), (v, h)),
+                  "cls_bias": jnp.zeros((v,), jnp.float32)}
+        for i in range(self.num_layers):
+            in_dim = e if i == 0 else h
+            params[f"l{i}_i2h_weight"] = mat(next(ki), (4 * h, in_dim))
+            params[f"l{i}_i2h_bias"] = jnp.zeros((4 * h,), jnp.float32)
+            params[f"l{i}_h2h_weight"] = mat(next(ki), (4 * h, h))
+            params[f"l{i}_h2h_bias"] = jnp.zeros((4 * h,), jnp.float32)
+        return params
+
+    def _cell(self, params, layer, x, c, h):
+        """One LSTM cell step; gate order (i, g, f, o) matches lstm_unroll's
+        SliceChannel order (in, transform, forget, out)."""
+        gates = (x @ params[f"l{layer}_i2h_weight"].T
+                 + params[f"l{layer}_i2h_bias"]
+                 + h @ params[f"l{layer}_h2h_weight"].T
+                 + params[f"l{layer}_h2h_bias"])
+        i, g, f, o = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return c_new, h_new
+
+    def forward(self, params, tokens, init_states=None):
+        """tokens [batch, seq] int -> logits [batch, seq, vocab], final states."""
+        b, _s = tokens.shape
+        hdim = self.num_hidden
+        if init_states is None:
+            init_states = [(jnp.zeros((b, hdim), jnp.float32),
+                            jnp.zeros((b, hdim), jnp.float32))
+                           for _ in range(self.num_layers)]
+        embeds = jnp.take(params["embed_weight"], tokens, axis=0)  # [b, s, e]
+
+        def step(carry, x_t):
+            new_carry = []
+            inp = x_t
+            for layer, (c, h) in enumerate(carry):
+                c2, h2 = self._cell(params, layer, inp, c, h)
+                new_carry.append((c2, h2))
+                inp = h2
+            return new_carry, inp
+
+        final, hs = lax.scan(step, init_states,
+                             jnp.swapaxes(embeds, 0, 1))  # scan over seq
+        hs = jnp.swapaxes(hs, 0, 1)  # [b, s, h]
+        logits = hs @ params["cls_weight"].T + params["cls_bias"]
+        return logits, final
+
+    def loss(self, params, tokens, targets):
+        logits, _ = self.forward(params, tokens)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    def init_optimizer(self, params):
+        return {k: jnp.zeros_like(v) for k, v in params.items()}
+
+    def make_train_step(self, lr=0.5, momentum=0.9, clip=None):
+        def step(params, moms, tokens, targets):
+            loss, grads = jax.value_and_grad(self.loss)(params, tokens, targets)
+            if clip is not None:
+                grads = {k: jnp.clip(g, -clip, clip) for k, g in grads.items()}
+            new_moms = {k: momentum * moms[k] + grads[k] for k in params}
+            new_params = {k: params[k] - lr * new_moms[k] for k in params}
+            return new_params, new_moms, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))

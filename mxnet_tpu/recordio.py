@@ -127,6 +127,26 @@ class MXIndexedRecordIO(MXRecordIO):
         return self.read()
 
 
+def scan_offsets(uri: str) -> list[int]:
+    """Record offsets by header-seeking (no payload reads, no crc check) —
+    constructor-time scan of large shards stays I/O-light. The native library
+    exposes the same scan (mxtpu_scan_offsets); this is the fallback."""
+    offsets = []
+    with open(uri, "rb") as f:
+        pos = 0
+        while True:
+            header = f.read(_HEADER.size)
+            if len(header) < _HEADER.size:
+                break
+            magic, _crc, length = _HEADER.unpack(header)
+            if magic != RECORD_MAGIC:
+                raise MXNetError(f"corrupt record file {uri!r}: bad magic")
+            offsets.append(pos)
+            pos += _HEADER.size + length + ((-length) % 8)
+            f.seek(pos)
+    return offsets
+
+
 # label header packed in front of image payloads (reference: image_recordio.h)
 IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
 _IR = struct.Struct("<IfQQ")

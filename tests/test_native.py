@@ -1,0 +1,151 @@
+"""Native C++ pipeline tests: CREC format compatibility with the Python
+writer, decode parity against PIL (both link the same libjpeg), augment
+behavior, shuffle determinism, and ImageRecordIter integration."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import recordio as rio
+from mxnet_tpu import native as native_mod
+
+pytestmark = pytest.mark.skipif(
+    native_mod.get_lib() is None, reason="native library unavailable"
+)
+
+
+def _make_jpeg_rec(tmp_path, n=20, size=40, quality=95):
+    path = str(tmp_path / "imgs.rec")
+    w = rio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    imgs, labels = [], []
+    for i in range(n):
+        # smooth gradients survive JPEG better than noise
+        yy, xx = np.meshgrid(np.arange(size), np.arange(size), indexing="ij")
+        img = np.stack([(yy * 255 / size), (xx * 255 / size),
+                        np.full_like(yy, (i * 13) % 255)], axis=-1).astype(np.uint8)
+        imgs.append(img)
+        labels.append(float(i))
+        w.write(rio.pack_img(rio.IRHeader(0, labels[-1], i, 0), img,
+                             quality=quality, img_fmt=".jpg"))
+    w.close()
+    return path, imgs, labels
+
+
+def test_scan_offsets_matches_python(tmp_path):
+    path, _, _ = _make_jpeg_rec(tmp_path)
+    native_offs = native_mod.scan_offsets(path)
+    # python-side offsets
+    r = rio.MXRecordIO(path, "r")
+    py_offs = []
+    while True:
+        pos = r.tell()
+        if r.read() is None:
+            break
+        py_offs.append(pos)
+    r.close()
+    assert native_offs == py_offs
+
+
+def test_native_pipeline_decode_matches_pil(tmp_path):
+    path, imgs, labels = _make_jpeg_rec(tmp_path, n=8, size=32)
+    offs = native_mod.scan_offsets(path)
+    pipe = native_mod.NativePipeline(path, offs, batch=8, data_shape=(3, 32, 32))
+    data, lab, pad = pipe.next()
+    assert pad == 0
+    np.testing.assert_allclose(lab, labels)
+    # decode parity: PIL and the native path share libjpeg
+    from PIL import Image
+    import io as pyio
+
+    r = rio.MXRecordIO(path, "r")
+    for i in range(8):
+        rec = r.read()
+        _, img = rio.unpack_img(rec)
+        np.testing.assert_allclose(
+            data[i], img.transpose(2, 0, 1).astype(np.float32), atol=1.0
+        )
+    r.close()
+
+
+def test_native_pipeline_epoch_and_pad(tmp_path):
+    path, _, labels = _make_jpeg_rec(tmp_path, n=10, size=32)
+    offs = native_mod.scan_offsets(path)
+    pipe = native_mod.NativePipeline(path, offs, batch=4, data_shape=(3, 32, 32))
+    assert pipe.batches_per_epoch == 3
+    pads = []
+    seen = []
+    for _ in range(3):
+        d, l, p = pipe.next()
+        pads.append(p)
+        seen.extend(l.tolist())
+    assert pads == [0, 0, 2]  # wrap pad on the last batch
+    assert seen[:10] == labels
+    with pytest.raises(StopIteration):
+        pipe.next()
+    pipe.reset()
+    d, l, p = pipe.next()
+    np.testing.assert_allclose(l, labels[:4])
+
+
+def test_native_pipeline_shuffle_deterministic(tmp_path):
+    path, _, _ = _make_jpeg_rec(tmp_path, n=16, size=32)
+    offs = native_mod.scan_offsets(path)
+
+    def epoch_labels(seed):
+        pipe = native_mod.NativePipeline(path, offs, batch=8,
+                                         data_shape=(3, 32, 32), shuffle=True,
+                                         seed=seed)
+        out = []
+        for _ in range(2):
+            _, l, _ = pipe.next()
+            out.extend(l.tolist())
+        return out
+
+    a, b = epoch_labels(7), epoch_labels(7)
+    c = epoch_labels(8)
+    assert a == b
+    assert a != c
+    assert sorted(a) == list(map(float, range(16)))
+
+
+def test_native_mean_scale_crop(tmp_path):
+    path, imgs, _ = _make_jpeg_rec(tmp_path, n=4, size=40)
+    offs = native_mod.scan_offsets(path)
+    pipe = native_mod.NativePipeline(path, offs, batch=4, data_shape=(3, 32, 32),
+                                     mean=[128, 128, 128], scale=1 / 128.0)
+    data, _, _ = pipe.next()
+    # center crop of the deterministic gradient image, mean/scale applied
+    expect = (imgs[0][4:36, 4:36].transpose(2, 0, 1).astype(np.float32)
+              - 128.0) / 128.0
+    np.testing.assert_allclose(data[0], expect, atol=0.05)
+
+
+def test_image_record_iter_uses_native_for_jpeg(tmp_path):
+    path, _, labels = _make_jpeg_rec(tmp_path, n=12, size=36)
+    it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                             batch_size=4)
+    assert it._native is not None, "JPEG records should take the native path"
+    got = []
+    for b in it:
+        got.extend(b.label[0].asnumpy().tolist())
+    assert got == labels
+    # second epoch works
+    got2 = [x for b in it for x in b.label[0].asnumpy().tolist()]
+    assert got2 == labels
+
+
+def test_image_record_iter_falls_back_for_png(tmp_path):
+    path = str(tmp_path / "png.rec")
+    w = rio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        img = rng.randint(0, 255, (32, 32, 3), np.uint8)
+        w.write(rio.pack_img(rio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    w.close()
+    it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                             batch_size=3)
+    assert it._native is None, "PNG records must fall back to the PIL path"
+    labels = [x for b in it for x in b.label[0].asnumpy().tolist()]
+    assert labels == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]

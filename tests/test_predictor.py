@@ -1,0 +1,56 @@
+"""Predictor tests (reference: c_predict_api surface — create from
+checkpoint, set_input/forward/get_output, single-file export bundle)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.predictor import Predictor
+
+
+def _trained_model(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=8)
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=2)
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+    model = mx.FeedForward(net, ctx=mx.cpu(), num_epoch=5,
+                           initializer=mx.init.Xavier())
+    model.kwargs = {"lr": 0.5}
+    model.fit(X, y, batch_size=50)
+    prefix = str(tmp_path / "m")
+    model.save(prefix, 5)
+    return model, prefix, X, y
+
+
+def test_predictor_from_checkpoint(tmp_path):
+    model, prefix, X, y = _trained_model(tmp_path)
+    pred = Predictor.create(prefix, 5, ctx=mx.cpu())
+    pred.forward(data=X[:32])
+    out = pred.get_output(0)
+    assert out.shape == (32, 2)
+    expect = model.predict(X[:32], batch_size=32)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_export_bundle(tmp_path):
+    model, prefix, X, y = _trained_model(tmp_path)
+    pred = Predictor.create(prefix, 5, ctx=mx.cpu())
+    bundle = str(tmp_path / "model.mxtpu")
+    pred.export(bundle)
+    loaded = Predictor.load(bundle, ctx=mx.cpu())
+    loaded.forward(data=X[:16])
+    pred.forward(data=X[:16])
+    np.testing.assert_allclose(loaded.get_output(0), pred.get_output(0),
+                               rtol=1e-5)
+
+
+def test_predictor_requires_forward(tmp_path):
+    _, prefix, X, _ = _trained_model(tmp_path)
+    pred = Predictor.create(prefix, 5)
+    with pytest.raises(mx.MXNetError):
+        pred.get_output(0)

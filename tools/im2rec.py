@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Pack images into a RecordIO file (reference: tools/im2rec.cc).
+
+Usage:
+  python tools/im2rec.py <list-file> <image-root> <out.rec> [--resize N]
+                         [--quality Q] [--center-crop]
+
+List file format (reference-compatible): one image per line,
+  <index>\t<label>\t<relative-path>
+Multi-label: <index>\t<l1>\t<l2>...\t<path> (label vector).
+
+Or build a list from a directory tree (class per subfolder):
+  python tools/im2rec.py --make-list <image-root> <out.lst>
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def make_list(root: str, out_lst: str):
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+    idx = 0
+    with open(out_lst, "w") as f:
+        for label, cls in enumerate(classes):
+            for fname in sorted(os.listdir(os.path.join(root, cls))):
+                if fname.lower().endswith((".jpg", ".jpeg", ".png", ".bmp")):
+                    f.write(f"{idx}\t{float(label)}\t{cls}/{fname}\n")
+                    idx += 1
+    print(f"wrote {idx} entries ({len(classes)} classes) to {out_lst}")
+
+
+def pack(list_file: str, root: str, out_rec: str, resize=0, quality=95,
+         center_crop=False):
+    from PIL import Image
+
+    from mxnet_tpu import recordio as rio
+
+    writer = rio.MXIndexedRecordIO(out_rec + ".idx", out_rec, "w")
+    count = 0
+    with open(list_file) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            path = os.path.join(root, parts[-1])
+            img = Image.open(path).convert("RGB")
+            if resize:
+                w, h = img.size
+                s = resize / min(w, h)
+                img = img.resize((int(w * s + 0.5), int(h * s + 0.5)))
+            if center_crop:
+                w, h = img.size
+                side = min(w, h)
+                left, top = (w - side) // 2, (h - side) // 2
+                img = img.crop((left, top, left + side, top + side))
+            arr = np.asarray(img)
+            if len(labels) == 1:
+                header = rio.IRHeader(0, labels[0], idx, 0)
+            else:
+                header = rio.IRHeader(len(labels), labels, idx, 0)
+            writer.write_idx(idx, rio.pack_img(header, arr, quality=quality,
+                                               img_fmt=".jpg"))
+            count += 1
+            if count % 1000 == 0:
+                print(f"packed {count} images")
+    writer.close()
+    print(f"wrote {count} records to {out_rec}")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("args", nargs="+")
+    ap.add_argument("--make-list", action="store_true")
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--center-crop", action="store_true")
+    a = ap.parse_args()
+    if a.make_list:
+        make_list(a.args[0], a.args[1])
+    else:
+        pack(a.args[0], a.args[1], a.args[2], resize=a.resize,
+             quality=a.quality, center_crop=a.center_crop)
+
+
+if __name__ == "__main__":
+    main()
