@@ -94,6 +94,8 @@ def _init_iter(X, y, batch_size, shuffle=False, is_train=True):
     if isinstance(X, (np.ndarray, NDArray)):
         if is_train and y is None:
             raise MXNetError("y is required when X is array-like")
+        # reference model.py:609 clamps batch_size to the dataset size
+        batch_size = min(batch_size, X.shape[0])
         return io_mod.NDArrayIter(X, y, batch_size=batch_size, shuffle=shuffle)
     raise MXNetError(f"cannot handle input type {type(X)}")
 
@@ -402,10 +404,19 @@ class FeedForward(BASE_ESTIMATOR):
         results = [np.concatenate(lst, axis=0) for lst in chunks]
         return results[0] if len(results) == 1 else results
 
-    def score(self, X, eval_metric="accuracy", batch_size=128):
+    def score(self, X, *, y=None, eval_metric="accuracy", batch_size=128):
         """Evaluate a metric over a labeled dataset (capability extension;
-        later-MXNet surface)."""
-        data_iter = _init_iter(X, None, batch_size, is_train=False)
+        later-MXNet surface). X may be a DataIter with labels, or a raw
+        array with labels passed as y=."""
+        if hasattr(X, "provide_data"):
+            if y is not None:
+                raise MXNetError(
+                    "score(): pass labels inside the DataIter, not as y=")
+        elif y is None:
+            raise MXNetError(
+                "score() on a raw array needs labels: score(X, y=labels) — "
+                "or pass a DataIter that provides labels")
+        data_iter = _init_iter(X, y, batch_size, is_train=False)
         eval_metric = metric_mod.create(eval_metric)
         params = {k: v.data for k, v in self.arg_params.items()}
         aux = {k: v.data for k, v in (self.aux_params or {}).items()}

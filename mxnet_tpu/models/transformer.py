@@ -30,7 +30,9 @@ __all__ = ["transformer_lm_config", "TransformerLM"]
 
 
 def transformer_lm_config(vocab_size=32000, d_model=512, n_heads=8, n_layers=4,
-                          d_ff=None, max_len=2048, dtype=jnp.bfloat16):
+                          d_ff=None, max_len=2048, dtype=jnp.bfloat16,
+                          attn_impl="auto"):
+    """attn_impl: 'flash' (Pallas kernel), 'dense', or 'auto' (flash on TPU)."""
     return {
         "vocab_size": vocab_size,
         "d_model": d_model,
@@ -39,6 +41,7 @@ def transformer_lm_config(vocab_size=32000, d_model=512, n_heads=8, n_layers=4,
         "d_ff": d_ff or 4 * d_model,
         "max_len": max_len,
         "dtype": dtype,
+        "attn_impl": attn_impl,
     }
 
 
@@ -53,6 +56,14 @@ def _layernorm(x, scale, bias, eps=1e-5):
 class TransformerLM:
     def __init__(self, config):
         self.cfg = dict(config)
+
+    def _use_flash(self) -> bool:
+        impl = self.cfg.get("attn_impl", "auto")
+        if impl == "flash":
+            return True
+        if impl == "dense":
+            return False
+        return jax.default_backend() == "tpu"
 
     # -- parameters -----------------------------------------------------------
     def init_params(self, key) -> dict:
@@ -144,6 +155,21 @@ class TransformerLM:
             v = cst(v, P("dp", "tp", "sp", None))
             if use_sp:
                 attn = ring_self_attention(mesh, q, k, v, causal=True)
+            elif self._use_flash():
+                from ..ops.pallas import flash_attention
+                if mesh is None:
+                    attn = flash_attention(q, k, v, causal=True)
+                else:
+                    # pallas_call has no GSPMD partitioning rule; run the
+                    # kernel per-shard over (dp, tp) via shard_map so the
+                    # sharded train step keeps its partitioning.
+                    from jax import shard_map
+                    spec = P("dp", "tp", None, None)
+                    attn = shard_map(
+                        functools.partial(flash_attention, causal=True),
+                        mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_vma=False,
+                    )(q, k, v)
             else:
                 attn = attention_reference(q, k, v, causal=True)
             attn = attn.transpose(0, 2, 1, 3).reshape(x.shape[0], seq, d)

@@ -1,0 +1,19 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference's answer to "the op is the bottleneck" is a hand-written CUDA
+kernel behind mshadow (SURVEY.md §2.7); ours is a Pallas kernel that tiles
+onto the MXU/VPU with VMEM-resident blocks. Only ops where XLA fusion is
+insufficient get a kernel (pallas_guide.md playbook); everything else stays
+jax.numpy.
+
+Kernels:
+  flash_attention -- blocked online-softmax attention, O(seq) memory,
+                     custom VJP with Pallas forward and backward kernels.
+
+On non-TPU backends every kernel runs in Pallas interpret mode, so the unit
+tests exercise the real kernel code paths on the 8-device CPU mesh.
+"""
+
+from .flash_attention import flash_attention  # noqa: F401
+
+__all__ = ["flash_attention"]

@@ -26,9 +26,11 @@ class Optimizer:
     """Base optimizer. Subclasses implement create_state and pure _step."""
 
     def __init__(self, rescale_grad=1.0, lr=0.01, wd=0.0, clip_gradient=None,
-                 lr_scheduler=None, arg_names=None):
+                 lr_scheduler=None, arg_names=None, learning_rate=None):
         self.rescale_grad = rescale_grad
-        self.lr = lr
+        # 'learning_rate' is the reference's kwarg name (optimizer.py SGD);
+        # 'lr' is the short form used throughout this package — accept both.
+        self.lr = lr if learning_rate is None else learning_rate
         self.wd = wd
         self.clip_gradient = clip_gradient
         self.lr_scheduler = lr_scheduler

@@ -37,8 +37,6 @@ def test_feedforward_fit_accuracy():
     X, y = _two_blob_dataset()
     model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=8,
                            learning_rate=0.5, optimizer="sgd", momentum=0.9)
-    # map kwargs: optimizer kwargs are passed through FeedForward(**kwargs)
-    model.kwargs = {"lr": 0.5, "momentum": 0.9}
     model.fit(X, y, batch_size=40)
     preds = model.predict(X, batch_size=40)
     acc = (preds.argmax(axis=1) == y).mean()
@@ -49,8 +47,8 @@ def test_feedforward_eval_data_and_score():
     Xall, yall = _two_blob_dataset(n=600, seed=1)
     X, y = Xall[:400], yall[:400]
     Xv, yv = Xall[400:], yall[400:]
-    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=6)
-    model.kwargs = {"lr": 0.5}
+    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=6,
+                           learning_rate=0.5)
     val_iter = mx.io.NDArrayIter(Xv, yv, batch_size=40)
     model.fit(X, y, eval_data=val_iter, batch_size=40)
     score = model.score(mx.io.NDArrayIter(Xv, yv, batch_size=40))
@@ -59,8 +57,8 @@ def test_feedforward_eval_data_and_score():
 
 def test_feedforward_checkpoint_roundtrip(tmp_path):
     X, y = _two_blob_dataset()
-    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=3)
-    model.kwargs = {"lr": 0.5}
+    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=3,
+                           learning_rate=0.5)
     model.fit(X, y, batch_size=40)
     p1 = model.predict(X, batch_size=40)
     prefix = str(tmp_path / "mlp")
@@ -74,8 +72,7 @@ def test_feedforward_multi_device_dp():
     """Data parallel over multiple virtual devices: same convergence."""
     X, y = _two_blob_dataset()
     model = mx.FeedForward(_mlp_sym(), ctx=[mx.cpu(i) for i in range(4)],
-                           num_epoch=6)
-    model.kwargs = {"lr": 0.5}
+                           num_epoch=6, learning_rate=0.5)
     model.fit(X, y, batch_size=40, kvstore="device")
     preds = model.predict(X, batch_size=40)
     acc = (preds.argmax(axis=1) == y).mean()
@@ -93,8 +90,8 @@ def test_feedforward_create():
 def test_epoch_and_batch_callbacks():
     X, y = _two_blob_dataset()
     epochs, batches = [], []
-    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=2)
-    model.kwargs = {"lr": 0.1}
+    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=2,
+                           learning_rate=0.1)
     model.fit(
         X, y, batch_size=40,
         epoch_end_callback=lambda e, s, a, x: epochs.append(e),
