@@ -11,7 +11,9 @@ from .resnet import resnet, resnet50
 from .lstm import lstm_unroll, LSTMState, LSTMParam
 from .lstm_scan import LSTMLM
 from .transformer import TransformerLM, transformer_lm_config
+from .moe_transformer import MoEPipelineLM, moe_pipeline_config
 
 __all__ = ["mlp", "lenet", "alexnet", "inception_bn_cifar", "inception_bn",
            "resnet", "resnet50", "lstm_unroll", "LSTMState", "LSTMParam",
-           "LSTMLM", "TransformerLM", "transformer_lm_config"]
+           "LSTMLM", "TransformerLM", "transformer_lm_config",
+           "MoEPipelineLM", "moe_pipeline_config"]

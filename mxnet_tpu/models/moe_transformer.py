@@ -1,0 +1,187 @@
+"""MoE pipeline-parallel LM: dp + pp + ep in one train step.
+
+Complements models/transformer.py (which covers dp/tp/sp): here the mesh
+axes are (dp, pp, ep) — GPipe microbatch pipelining over 'pp'
+(parallel.pipeline), Switch-style expert parallelism over 'ep'
+(parallel.expert), batch sharded over (dp, ep). The whole forward runs
+inside one shard_map; jax.grad differentiates through the scan/ppermute/
+all_to_all, so the backward pipeline and inverse expert routing come from
+AD, not hand-written schedules.
+
+Each pipeline stage = pre-LN causal self-attention + MoE FFN.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.expert import moe_ffn
+from ..parallel.pipeline import spmd_pipeline
+from ..parallel.sequence import attention_reference
+from .transformer import _layernorm
+
+__all__ = ["MoEPipelineLM", "moe_pipeline_config"]
+
+
+def moe_pipeline_config(vocab_size=1024, d_model=64, n_heads=4, d_ff=None,
+                        n_experts=4, max_len=64, n_micro=4,
+                        capacity_factor=2.0, aux_loss_coef=0.01):
+    return {
+        "vocab_size": vocab_size, "d_model": d_model, "n_heads": n_heads,
+        "d_ff": d_ff or 4 * d_model, "n_experts": n_experts,
+        "max_len": max_len, "n_micro": n_micro,
+        "capacity_factor": capacity_factor, "aux_loss_coef": aux_loss_coef,
+    }
+
+
+class MoEPipelineLM:
+    """One transformer block per pipeline stage; stage count = mesh pp size."""
+
+    def __init__(self, config):
+        self.cfg = dict(config)
+
+    def _param_specs(self):
+        """PartitionSpec per param. Stage-stacked leaves lead with 'pp';
+        expert-stacked leaves also shard 'ep'."""
+        return {
+            "embed": P(), "pos_embed": P(),
+            "final_norm_scale": P(), "final_norm_bias": P(),
+            "lm_head": P(),
+            "ln1_scale": P("pp", None), "ln1_bias": P("pp", None),
+            "ln2_scale": P("pp", None), "ln2_bias": P("pp", None),
+            "wqkv": P("pp", None, None), "wo": P("pp", None, None),
+            "gate": P("pp", None, None),
+            "w1": P("pp", "ep", None, None),
+            "w2": P("pp", "ep", None, None),
+        }
+
+    def init_params(self, key, n_stages: int):
+        cfg = self.cfg
+        d, ff, v, e = (cfg["d_model"], cfg["d_ff"], cfg["vocab_size"],
+                       cfg["n_experts"])
+        ks = jax.random.split(key, 8)
+
+        def dense(k, shape, scale):
+            return jax.random.normal(k, shape, jnp.float32) * scale
+
+        s = 1.0 / math.sqrt(d)
+        return {
+            "embed": dense(ks[0], (v, d), 0.02),
+            "pos_embed": dense(ks[1], (cfg["max_len"], d), 0.02),
+            "final_norm_scale": jnp.ones((d,)), "final_norm_bias": jnp.zeros((d,)),
+            "lm_head": dense(ks[2], (d, v), s),
+            "ln1_scale": jnp.ones((n_stages, d)), "ln1_bias": jnp.zeros((n_stages, d)),
+            "ln2_scale": jnp.ones((n_stages, d)), "ln2_bias": jnp.zeros((n_stages, d)),
+            "wqkv": dense(ks[3], (n_stages, d, 3 * d), s),
+            "wo": dense(ks[4], (n_stages, d, d), s),
+            "gate": dense(ks[5], (n_stages, d, e), s),
+            "w1": dense(ks[6], (n_stages, e, d, ff), s),
+            "w2": dense(ks[7], (n_stages, e, ff, d), 1.0 / math.sqrt(ff)),
+        }
+
+    def param_shardings(self, mesh: Mesh):
+        specs = self._param_specs()
+        return {k: NamedSharding(mesh, v) for k, v in specs.items()}
+
+    def init_sharded(self, mesh: Mesh, seed=0):
+        n_stages = mesh.shape["pp"]
+        params = self.init_params(jax.random.PRNGKey(seed), n_stages)
+        sh = self.param_shardings(mesh)
+        params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        moms = {k: jax.device_put(jnp.zeros_like(v), sh[k])
+                for k, v in params.items()}
+        return params, moms
+
+    # -- forward + loss inside shard_map --------------------------------------
+    def _block(self, p, x):
+        """One stage on one microbatch. p leaves carry a leading size-1
+        stage axis (this shard's slice); x: [mb, seq, d]."""
+        cfg = self.cfg
+        h = cfg["n_heads"]
+        mb, seq, d = x.shape
+        hd = d // h
+        y = _layernorm(x, p["ln1_scale"][0], p["ln1_bias"][0])
+        qkv = jnp.einsum("bsd,df->bsf", y, p["wqkv"][0],
+                         preferred_element_type=jnp.float32)
+        qkv = qkv.reshape(mb, seq, 3, h, hd)
+        q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+        attn = attention_reference(q, k, v, causal=True)
+        attn = attn.transpose(0, 2, 1, 3).reshape(mb, seq, d)
+        x = x + jnp.einsum("bsd,df->bsf", attn, p["wo"][0],
+                           preferred_element_type=jnp.float32)
+        y = _layernorm(x, p["ln2_scale"][0], p["ln2_bias"][0])
+        tok = y.reshape(mb * seq, d)
+        out, aux = moe_ffn(tok, p["gate"][0], p["w1"][0], p["w2"][0],
+                           axis_name="ep",
+                           capacity_factor=cfg["capacity_factor"],
+                           return_aux=True)
+        return x + out.reshape(mb, seq, d), aux
+
+    def _sharded_loss(self, params, tokens, targets):
+        """Runs per-shard inside shard_map over (dp, pp, ep)."""
+        cfg = self.cfg
+        n_micro = cfg["n_micro"]
+        d = cfg["d_model"]
+        mb_total, seq = tokens.shape  # local batch (sharded over dp, ep)
+        mb = mb_total // n_micro
+
+        x = jnp.take(params["embed"], tokens, axis=0) * math.sqrt(d)
+        x = x + params["pos_embed"][:seq]
+        x_micro = x.reshape(n_micro, mb, seq, d)
+
+        stage_keys = ("ln1_scale", "ln1_bias", "ln2_scale", "ln2_bias",
+                      "wqkv", "wo", "gate", "w1", "w2")
+        stage_params = {k: params[k] for k in stage_keys}
+        pipe = spmd_pipeline(self._block, n_micro, axis_name="pp",
+                             with_aux=True)
+        outs, aux_sum = pipe(stage_params, x_micro)
+        outs = outs.reshape(mb_total, seq, d)
+
+        # only the last pp stage holds real outputs; others contribute 0
+        pp_idx = lax.axis_index("pp")
+        pp_size = lax.psum(1, "pp")
+        y = _layernorm(outs, params["final_norm_scale"], params["final_norm_bias"])
+        logits = jnp.einsum("bsd,dv->bsv", y, params["lm_head"],
+                            preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        local = jnp.where(pp_idx == pp_size - 1, jnp.sum(nll), 0.0)
+        count = jnp.asarray(mb_total * seq, jnp.float32)
+        total = lax.psum(local, ("pp", "dp", "ep"))
+        n = lax.psum(jnp.where(pp_idx == pp_size - 1, count, 0.0),
+                     ("pp", "dp", "ep"))
+        # Switch load-balance aux: summed over stages (one MoE per stage),
+        # averaged over microbatches and data shards
+        aux = lax.pmean(lax.psum(aux_sum / n_micro, "pp"), ("dp", "ep"))
+        return total / n + cfg["aux_loss_coef"] * aux
+
+    def loss(self, mesh: Mesh, params, tokens, targets):
+        specs = self._param_specs()
+        data = P(("dp", "ep"), None)
+        fn = shard_map(self._sharded_loss, mesh=mesh,
+                       in_specs=(specs, data, data), out_specs=P(),
+                       check_vma=False)
+        return fn(params, tokens, targets)
+
+    def make_train_step(self, mesh: Mesh, lr=0.1, momentum=0.9):
+        pshard = self.param_shardings(mesh)
+        dshard = NamedSharding(mesh, P(("dp", "ep"), None))
+
+        def step(params, moms, tokens, targets):
+            loss, grads = jax.value_and_grad(
+                lambda p: self.loss(mesh, p, tokens, targets))(params)
+            new_moms = {k: momentum * moms[k] + grads[k] for k in params}
+            new_params = {k: params[k] - lr * new_moms[k] for k in params}
+            return new_params, new_moms, loss
+
+        return jax.jit(
+            step,
+            in_shardings=(pshard, pshard, dshard, dshard),
+            out_shardings=(pshard, pshard, NamedSharding(mesh, P())),
+            donate_argnums=(0, 1),
+        )

@@ -157,8 +157,13 @@ class TransformerLM:
                 attn = ring_self_attention(mesh, q, k, v, causal=True)
             elif self._use_flash():
                 from ..ops.pallas import flash_attention
-                if mesh is None:
-                    attn = flash_attention(q, k, v, causal=True)
+                if mesh is None or q.shape[0] % mesh.shape.get("dp", 1) or \
+                        h % mesh.shape.get("tp", 1):
+                    # shard_map needs even partitioning; uneven batch/head
+                    # counts stay on the GSPMD-padded dense path
+                    attn = (flash_attention(q, k, v, causal=True)
+                            if mesh is None
+                            else attention_reference(q, k, v, causal=True))
                 else:
                     # pallas_call has no GSPMD partitioning rule; run the
                     # kernel per-shard over (dp, tp) via shard_map so the

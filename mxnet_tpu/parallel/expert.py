@@ -1,0 +1,98 @@
+"""Expert parallelism over the 'ep' mesh axis (GShard/Switch-style MoE).
+
+New capability vs. the reference (SURVEY.md §2.4: its only parallelism is
+data parallel). Top-1 gated mixture-of-experts FFN with fixed expert
+capacity: tokens are dispatched to their expert's owner shard with
+``lax.all_to_all`` over ICI, the expert matmuls run batched on the MXU, and
+results return through the inverse all-to-all. Dispatch/combine are the
+standard one-hot einsums, so the whole layer is differentiable and
+partitioner-friendly.
+
+Call ``moe_ffn`` inside shard_map with tokens sharded over 'ep' (usually
+jointly with 'dp') and expert weights sharded on their leading expert dim.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["moe_ffn", "init_moe_params"]
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int, dtype=jnp.float32):
+    """Gate + stacked expert weights. Shard w1/w2 on their expert dim."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = 1.0 / (d_model ** 0.5)
+    s2 = 1.0 / (d_ff ** 0.5)
+    return {
+        "gate": jax.random.normal(k1, (d_model, n_experts), dtype) * s1,
+        "w1": jax.random.normal(k2, (n_experts, d_model, d_ff), dtype) * s1,
+        "w2": jax.random.normal(k3, (n_experts, d_ff, d_model), dtype) * s2,
+    }
+
+
+def moe_ffn(x, gate_w, w1, w2, axis_name: str = "ep", capacity_factor: float = 2.0,
+            return_aux: bool = False):
+    """Top-1 MoE FFN inside shard_map.
+
+    x:       [tokens_local, d]   tokens sharded over axis_name
+    gate_w:  [d, E]              replicated (E = total experts)
+    w1, w2:  [E_local, d, ff] / [E_local, ff, d]  sharded over axis_name
+
+    Returns [tokens_local, d]; with return_aux=True also returns the Switch
+    load-balancing auxiliary loss (E * sum_e fraction_e * mean_prob_e over
+    local tokens — add it to the task loss with a small coefficient, or
+    top-1 routing collapses onto a few experts and over-capacity tokens are
+    dropped). Tokens over an expert's capacity are dropped (standard Switch
+    behavior) — residual connections carry them through.
+    """
+    ep = lax.psum(1, axis_name)
+    t_local, d = x.shape
+    e_local = w1.shape[0]
+    n_experts = ep * e_local
+    capacity = max(1, int(capacity_factor * t_local / n_experts))
+
+    xf = x.astype(jnp.float32)
+    logits = xf @ gate_w.astype(jnp.float32)            # [t, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                 # [t]
+    gate = jnp.max(probs, axis=-1)                      # [t]
+
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # [t, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0     # slot within expert
+    keep = pos < capacity
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=jnp.float32) * keep[..., None]
+    dispatch = onehot[..., None] * pos_oh               # [t, E, C]
+
+    # dispatch tokens into per-expert buffers, then all-to-all to the
+    # expert-owner shards: chunk e of axis 0 (this shard's buffers for
+    # owner e's experts) goes to shard e; received buffers (one per source
+    # shard) concatenate along the capacity axis.
+    xe = jnp.einsum("tec,td->ecd", dispatch, xf)        # [E, C, d]
+    xe = lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=1,
+                        tiled=True)                      # [e_local, ep*C, d]
+
+    # batched expert FFN on the MXU
+    h = jnp.einsum("ecd,edf->ecf", xe, w1.astype(jnp.float32))
+    h = jax.nn.gelu(h)
+    ye = jnp.einsum("ecf,efd->ecd", h, w2.astype(jnp.float32))
+
+    # inverse route: peel the source-shard axis back out, send each source
+    # its slice, stack by source so row e is global expert e again
+    ye = ye.reshape(e_local, ep, capacity, d).transpose(1, 0, 2, 3)
+    ye = ye.reshape(n_experts, capacity, d)
+    ye = lax.all_to_all(ye, axis_name, split_axis=0, concat_axis=0,
+                        tiled=True)                      # [E, C, d]
+
+    y = jnp.einsum("tec,ecd->td", dispatch, ye) * gate[:, None]
+    y = y.astype(x.dtype)
+    if not return_aux:
+        return y
+    # Switch aux loss: fraction of tokens routed to e  ×  mean router prob
+    frac = jnp.mean(onehot, axis=0)                     # [E]
+    mean_prob = jnp.mean(probs, axis=0)                 # [E]
+    aux = jnp.float32(n_experts) * jnp.sum(frac * mean_prob)
+    return y, aux

@@ -1,0 +1,81 @@
+"""Pipeline parallelism over the 'pp' mesh axis (SPMD GPipe).
+
+New capability vs. the reference (its graph is single-device; SURVEY.md §2.4
+parallelism table). Design is the scaling-book SPMD pipeline: every device
+runs the same program inside ``shard_map``; stage-p holds slice p of the
+stacked per-stage parameters; activations hop stage→stage with
+``lax.ppermute`` over ICI each tick while new microbatches stream into stage
+0. ``jax.grad`` differentiates straight through the scan + ppermute, so the
+backward pass is the reverse pipeline — no hand-written schedule.
+
+The pipeline is bubbled (GPipe): T = n_micro + P - 1 ticks, bubble fraction
+(P-1)/T, amortized away by raising n_micro.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["spmd_pipeline"]
+
+
+def spmd_pipeline(block_fn, n_micro: int, axis_name: str = "pp",
+                  with_aux: bool = False):
+    """Build a pipelined apply: fn(stage_params, x_micro) -> y_micro.
+
+    block_fn(stage_params, x) applies ONE stage to one microbatch
+    [mb, ...] -> [mb, ...] (same shape). Call the returned function inside
+    shard_map with stage_params sharded on ``axis_name`` (leading stage dim
+    stripped to this shard's slice) and x_micro [n_micro, mb, ...]
+    replicated along ``axis_name``.
+
+    Returns y_micro [n_micro, mb, ...] valid on the LAST stage (zeros
+    elsewhere); callers typically reduce a loss there and psum it out.
+
+    With ``with_aux=True``, block_fn returns (y, aux_scalar) and the result
+    is (y_micro, aux_sum) where aux_sum accumulates this stage's aux over
+    its n_micro REAL microbatches only (bubble ticks run on garbage
+    activations and are masked out); psum over ``axis_name`` for the total.
+    """
+
+    def run(stage_params, x_micro):
+        p = lax.psum(1, axis_name)
+        idx = lax.axis_index(axis_name)
+        ticks = n_micro + p - 1
+        mb_shape = x_micro.shape[1:]
+
+        def tick(carry, t):
+            cur, outs, aux_sum = carry
+            # stage 0 ingests microbatch t (clamped; masked when t >= n_micro)
+            feed = lax.dynamic_index_in_dim(
+                x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+            cur = jnp.where(idx == 0, feed, cur)
+            if with_aux:
+                y, aux = block_fn(stage_params, cur)
+                # stage idx holds real data at ticks [idx, idx + n_micro)
+                real = jnp.logical_and(t >= idx, t < idx + n_micro)
+                aux_sum = aux_sum + jnp.where(real, aux, 0.0)
+            else:
+                y = block_fn(stage_params, cur)
+            # last stage emits microbatch t-(p-1) once the pipe is full
+            out_slot = jnp.clip(t - (p - 1), 0, n_micro - 1)
+            valid = jnp.logical_and(idx == p - 1, t >= p - 1)
+            outs = lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(valid, y, lax.dynamic_index_in_dim(
+                    outs, out_slot, 0, keepdims=False)),
+                out_slot, 0)
+            # activations hop to the next stage
+            perm = [(i, (i + 1) % p) for i in range(p)]
+            cur_next = lax.ppermute(y, axis_name, perm)
+            return (cur_next, outs, aux_sum), None
+
+        cur0 = jnp.zeros(mb_shape, x_micro.dtype)
+        outs0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+        (cur, outs, aux_sum), _ = lax.scan(
+            tick, (cur0, outs0, jnp.float32(0.0)), jnp.arange(ticks))
+        return (outs, aux_sum) if with_aux else outs
+
+    return run

@@ -1,0 +1,127 @@
+"""Pipeline (pp) and expert (ep) parallelism on the 8-device CPU mesh.
+
+Pipeline output is checked against a sequential stage-by-stage evaluation;
+MoE routing is checked with the identical-experts invariant (when every
+expert has the same weights and capacity is generous, routing must be
+equivalent to gate * dense FFN regardless of the dispatch plan)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.expert import init_moe_params, moe_ffn
+from mxnet_tpu.parallel.pipeline import spmd_pipeline
+
+
+def _pp_mesh(pp):
+    return make_mesh(pp=pp, devices=jax.devices()[:pp])
+
+
+def test_spmd_pipeline_matches_sequential():
+    pp, n_micro, mb, dim = 4, 6, 2, 8
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(pp, dim, dim).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.randn(n_micro, mb, dim).astype(np.float32))
+
+    def block(stage_w, xm):
+        return jnp.tanh(xm @ stage_w[0])
+
+    mesh = _pp_mesh(pp)
+    ref = x
+    for s in range(pp):
+        ref = jnp.tanh(ref @ w[s])
+
+    def pipe_and_share(stage_w, xm):
+        y = spmd_pipeline(block, n_micro, axis_name="pp")(stage_w, xm)
+        idx = lax.axis_index("pp")
+        p = lax.psum(1, "pp")
+        return lax.psum(jnp.where(idx == p - 1, y, 0.0), "pp")
+
+    fn2 = shard_map(pipe_and_share, mesh=mesh,
+                    in_specs=(P("pp", None, None), P(None, None, None)),
+                    out_specs=P(None, None, None), check_vma=False)
+    out = fn2(w, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_spmd_pipeline_grads_flow():
+    pp, n_micro, mb, dim = 2, 4, 2, 4
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.randn(pp, dim, dim).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.randn(n_micro, mb, dim).astype(np.float32))
+    mesh = _pp_mesh(pp)
+
+    def loss_fn(w):
+        def inner(stage_w, xm):
+            y = spmd_pipeline(lambda sw, m: jnp.tanh(m @ sw[0]),
+                              n_micro, axis_name="pp")(stage_w, xm)
+            idx = lax.axis_index("pp")
+            p = lax.psum(1, "pp")
+            return lax.psum(jnp.where(idx == p - 1, jnp.sum(y ** 2), 0.0), "pp")
+        return shard_map(inner, mesh=mesh,
+                         in_specs=(P("pp", None, None), P(None, None, None)),
+                         out_specs=P(), check_vma=False)(w, x)
+
+    g = jax.grad(loss_fn)(w)
+    assert g.shape == w.shape
+    # every stage's weights must receive signal through the pipeline
+    norms = np.asarray(jnp.sum(jnp.abs(g), axis=(1, 2)))
+    assert (norms > 1e-6).all(), norms
+
+
+@pytest.mark.parametrize("ep", [2, 4])
+def test_moe_identical_experts_equals_dense(ep):
+    d, ff, n_exp, tokens = 8, 16, 4, 32
+    rng = np.random.RandomState(2)
+    params = init_moe_params(jax.random.PRNGKey(0), d, ff, n_exp)
+    # make every expert identical
+    w1_one = params["w1"][:1]
+    w2_one = params["w2"][:1]
+    params["w1"] = jnp.broadcast_to(w1_one, params["w1"].shape)
+    params["w2"] = jnp.broadcast_to(w2_one, params["w2"].shape)
+    x = jnp.asarray(rng.randn(tokens, d).astype(np.float32))
+
+    mesh = make_mesh(ep=ep, devices=jax.devices()[:ep])
+    fn = shard_map(
+        lambda x, g, w1, w2: moe_ffn(x, g, w1, w2, axis_name="ep",
+                                     capacity_factor=float(n_exp)),
+        mesh=mesh,
+        in_specs=(P("ep", None), P(None, None),
+                  P("ep", None, None), P("ep", None, None)),
+        out_specs=P("ep", None), check_vma=False)
+    y = fn(x, params["gate"], params["w1"], params["w2"])
+
+    # dense equivalent: gate prob of chosen expert * shared FFN
+    logits = x @ params["gate"]
+    gate = jnp.max(jax.nn.softmax(logits, axis=-1), axis=-1)
+    h = jax.nn.gelu(x @ w1_one[0])
+    ref = (h @ w2_one[0]) * gate[:, None]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_pipeline_lm_trains():
+    from mxnet_tpu.models.moe_transformer import (MoEPipelineLM,
+                                                  moe_pipeline_config)
+
+    mesh = make_mesh(dp=2, pp=2, ep=2, devices=jax.devices()[:8])
+    cfg = moe_pipeline_config(vocab_size=64, d_model=16, n_heads=2,
+                              n_experts=4, max_len=16, n_micro=2)
+    model = MoEPipelineLM(cfg)
+    params, moms = model.init_sharded(mesh, seed=0)
+    step = model.make_train_step(mesh, lr=0.1)
+
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, 64, (8, 16)).astype(np.int32)
+    tgt = np.roll(tok, -1, axis=1).astype(np.int32)
+    losses = []
+    for _ in range(5):
+        params, moms, loss = step(params, moms, tok, tgt)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
