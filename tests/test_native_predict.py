@@ -1,0 +1,165 @@
+"""Native C++ predictor vs the JAX Predictor on exported bundles.
+
+Reference test pattern: tests/python/predict/ (the c_predict_api path) —
+export a trained graph, reload through the dependency-free runtime, and
+check outputs agree with the framework's own forward.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu.symbol as S
+from mxnet_tpu import random as mx_random
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.native import predict as native_predict
+
+pytestmark = pytest.mark.skipif(
+    native_predict.get_predict_lib() is None,
+    reason="native predict library unavailable")
+
+
+def _random_params(sym, input_shapes):
+    arg_shapes, _, aux_shapes = sym.infer_shape(**input_shapes)
+    rng = np.random.RandomState(7)
+    params, aux = {}, {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in input_shapes or name.endswith("_label"):
+            continue
+        params[name] = nd.array(rng.uniform(-0.5, 0.5, shape).astype(np.float32))
+    for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
+        if name.endswith("moving_var"):
+            aux[name] = nd.array(rng.uniform(0.5, 1.5, shape).astype(np.float32))
+        else:
+            aux[name] = nd.array(rng.uniform(-0.1, 0.1, shape).astype(np.float32))
+    return params, aux
+
+
+def _roundtrip(sym, input_shapes, tmp_path, atol=2e-4):
+    params, aux = _random_params(sym, input_shapes)
+    py_pred = Predictor(sym, params, aux, input_names=list(input_shapes))
+    rng = np.random.RandomState(3)
+    inputs = {k: rng.randn(*shape).astype(np.float32)
+              for k, shape in input_shapes.items()}
+    py_pred.forward(**inputs)
+    expected = [py_pred.get_output(i) for i in range(len(sym.list_outputs()))]
+
+    bundle = str(tmp_path / "model.mxtpu")
+    py_pred.export(bundle)
+    npred = native_predict.NativePredictor(bundle)
+    npred.forward(**inputs)
+    assert npred.num_outputs == len(expected)
+    for i, exp in enumerate(expected):
+        got = npred.get_output(i)
+        assert got.shape == exp.shape, (got.shape, exp.shape)
+        np.testing.assert_allclose(got, exp, atol=atol, rtol=1e-3)
+
+
+def test_mlp_bundle(tmp_path):
+    x = S.Variable("data")
+    h = S.FullyConnected(data=x, num_hidden=32, name="fc1")
+    h = S.Activation(data=h, act_type="relu", name="relu1")
+    h = S.FullyConnected(data=h, num_hidden=10, name="fc2")
+    net = S.SoftmaxOutput(data=h, name="softmax")
+    _roundtrip(net, {"data": (4, 20)}, tmp_path)
+
+
+def test_lenet_bundle(tmp_path):
+    from mxnet_tpu.models import lenet
+    _roundtrip(lenet(), {"data": (2, 1, 28, 28)}, tmp_path)
+
+
+def test_conv_bn_concat_slice_bundle(tmp_path):
+    x = S.Variable("data")
+    c1 = S.Convolution(data=x, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                       name="c1")
+    b1 = S.BatchNorm(data=c1, name="bn1")
+    a1 = S.Activation(data=b1, act_type="tanh", name="t1")
+    c2 = S.Convolution(data=x, kernel=(1, 1), num_filter=8, num_group=2,
+                       name="c2")
+    cat = S.Concat(a1, c2, name="cat")
+    parts = S.SliceChannel(data=cat, num_outputs=2, name="slice")
+    merged = parts[0] + parts[1]
+    pool = S.Pooling(data=merged, kernel=(2, 2), stride=(2, 2),
+                     pool_type="avg", name="pool")
+    lrn = S.LRN(data=pool, nsize=3, name="lrn")
+    flat = S.Flatten(data=lrn, name="flat")
+    net = S.LogisticRegressionOutput(data=flat, name="out")
+    _roundtrip(net, {"data": (2, 4, 8, 8)}, tmp_path)
+
+
+def test_leakyrelu_elementwise_bundle(tmp_path):
+    x = S.Variable("data")
+    l1 = S.LeakyReLU(data=x, act_type="leaky", slope=0.1, name="lk")
+    l2 = S.LeakyReLU(data=x, act_type="elu", slope=0.3, name="elu")
+    net = S.LinearRegressionOutput(data=l1 * l2 - x, name="out")
+    _roundtrip(net, {"data": (3, 6)}, tmp_path)
+
+
+def test_resnet_block_bundle(tmp_path):
+    """Residual unit: conv-bn-relu + identity shortcut (resnet building block)."""
+    x = S.Variable("data")
+    c = S.Convolution(data=x, kernel=(3, 3), pad=(1, 1), num_filter=4,
+                      no_bias=True, name="conv1")
+    b = S.BatchNorm(data=c, name="bn1")
+    r = S.Activation(data=b, act_type="relu", name="relu1")
+    s = r + x
+    pool = S.Pooling(data=s, kernel=(4, 4), global_pool=True,
+                     pool_type="avg", name="gap")
+    flat = S.Flatten(data=pool, name="flat")
+    fc = S.FullyConnected(data=flat, num_hidden=5, name="fc")
+    net = S.SoftmaxOutput(data=fc, name="softmax")
+    _roundtrip(net, {"data": (2, 4, 8, 8)}, tmp_path)
+
+
+def test_unary_reshape_transpose_bundle(tmp_path):
+    x = S.Variable("data")
+    u = S.Sqrt(data=S.Square(data=x))
+    u = S.Log(data=S.Exp(data=u))
+    r = S.Reshape(data=u, target_shape=(0, 2, -1))
+    t = S.Transpose(data=r, axes=(0, 2, 1))
+    net = S.LinearRegressionOutput(data=S.Flatten(data=t), name="out")
+    _roundtrip(net, {"data": (3, 8)}, tmp_path)
+
+
+def test_fix_gamma_batchnorm_bundle(tmp_path):
+    x = S.Variable("data")
+    b = S.BatchNorm(data=x, fix_gamma=True, name="bn")
+    net = S.LinearRegressionOutput(data=S.Flatten(data=b), name="out")
+    # gamma != 1 in the stored params must be ignored when fix_gamma=True
+    sym = net
+    params, aux = _random_params(sym, {"data": (2, 3, 4, 4)})
+    params["bn_gamma"] = nd.array(np.full((3,), 2.0, np.float32))
+    py_pred = Predictor(sym, params, aux, input_names=["data"])
+    rng = np.random.RandomState(5)
+    inp = rng.randn(2, 3, 4, 4).astype(np.float32)
+    py_pred.forward(data=inp)
+    expected = py_pred.get_output(0)
+    bundle = str(tmp_path / "bn.mxtpu")
+    py_pred.export(bundle)
+    npred = native_predict.NativePredictor(bundle)
+    npred.forward(data=inp)
+    np.testing.assert_allclose(npred.get_output(0), expected, atol=2e-4,
+                               rtol=1e-3)
+
+
+def test_embedding_bundle(tmp_path):
+    ids = S.Variable("data")
+    emb = S.Embedding(data=ids, input_dim=11, output_dim=6, name="emb")
+    net = S.LinearRegressionOutput(data=S.Flatten(data=emb), name="out")
+    params = {"emb_weight": nd.array(
+        np.random.RandomState(1).randn(11, 6).astype(np.float32))}
+    py_pred = Predictor(net, params, input_names=["data"])
+    inp = np.array([[0, 3, 10], [5, 1, 7]], np.float32)
+    py_pred.forward(data=inp)
+    expected = py_pred.get_output(0)
+    bundle = str(tmp_path / "emb.mxtpu")
+    py_pred.export(bundle)
+    npred = native_predict.NativePredictor(bundle)
+    npred.forward(data=inp)
+    np.testing.assert_allclose(npred.get_output(0), expected, atol=1e-5)
+
+
+def test_error_reporting(tmp_path):
+    with pytest.raises(RuntimeError, match="failed to load bundle"):
+        native_predict.NativePredictor(str(tmp_path / "missing.mxtpu"))
