@@ -14,6 +14,29 @@ Layering (cf. SURVEY.md §1):
   models/                  - the model zoo (MLP..ResNet-50, LSTM, transformer)
 """
 
+# Join the jax.distributed world BEFORE anything touches a backend: under
+# tools/launch.py each worker must initialize from the coordinator env vars
+# prior to the first jax call, or XLA pins a single-process backend and
+# dist_sync silently degrades to N independent runs (reference analog: the
+# DMLC_* wiring happens at import via kvstore_server's role switch).
+def _join_launcher_world():
+    import os
+
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    nproc = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
+    rank = os.environ.get("MXTPU_WORKER_RANK")
+    if not coord or nproc <= 1 or rank is None:
+        return
+    import jax
+
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(coord, num_processes=nproc,
+                               process_id=int(rank))
+
+
+_join_launcher_world()
+
 from . import base, context, engine
 from .base import MXNetError
 from .context import Context, cpu, cpu_pinned, current_context, gpu, num_devices, tpu

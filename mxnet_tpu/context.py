@@ -22,16 +22,20 @@ _thread_local = threading.local()
 
 
 def _accelerator_devices():
-    """All non-CPU JAX devices (TPU chips), or [] when running CPU-only."""
-    return [d for d in jax.devices() if d.platform != "cpu"]
+    """Local (addressable) non-CPU JAX devices, or [] when CPU-only.
+
+    Local, not global: under jax.distributed each process may only place
+    data on its own devices; Contexts address the local slice, meshes
+    (parallel/mesh.py) address the global device set."""
+    return [d for d in jax.local_devices() if d.platform != "cpu"]
 
 
 def _cpu_devices():
     try:
-        return jax.devices("cpu")
+        return jax.local_devices(backend="cpu")
     except RuntimeError:
         # CPU platform not initialised (rare); fall back to default devices.
-        return jax.devices()
+        return jax.local_devices()
 
 
 class Context:

@@ -139,6 +139,39 @@ class _DeviceKVStore(KVStore):
     the first pushed value, so this differs from 'local' only in name."""
 
 
+_dist_init_tried = False
+
+
+def _maybe_init_distributed():
+    """Join the jax.distributed world described by tools/launch.py env vars.
+
+    The reference wires workers to the ps-lite tracker via DMLC_* env vars at
+    KVStore::Create time (kvstore.cc:17-49); we wire workers to the JAX
+    coordination service (CPU collectives over Gloo, TPU over ICI/DCN) at the
+    same point. No-op when already initialized, single-process, or when the
+    backend was created first (then the caller owns initialization).
+    """
+    global _dist_init_tried
+    if _dist_init_tried:
+        return
+    _dist_init_tried = True
+    import os
+
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    nproc = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
+    rank = os.environ.get("MXTPU_WORKER_RANK")
+    if not coord or nproc <= 1 or rank is None:
+        return
+    if jax.distributed.is_initialized():
+        return  # caller already joined the world themselves
+    try:
+        jax.distributed.initialize(coord, num_processes=nproc,
+                                   process_id=int(rank))
+    except (RuntimeError, ValueError) as e:
+        logging.warning("jax.distributed.initialize failed (%s); "
+                        "continuing single-process", e)
+
+
 class _DistKVStore(KVStore):
     """'dist_sync': BSP across jax.distributed processes.
 
@@ -155,6 +188,7 @@ class _DistKVStore(KVStore):
                 "dist_async has no TPU-native equivalent; using BSP dist_sync "
                 "semantics (see SURVEY.md §2.4)"
             )
+        _maybe_init_distributed()
         self._nproc = jax.process_count()
 
     @property

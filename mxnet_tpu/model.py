@@ -100,6 +100,39 @@ def _init_iter(X, y, batch_size, shuffle=False, is_train=True):
     raise MXNetError(f"cannot handle input type {type(X)}")
 
 
+def _host_local(x):
+    """A jax.Array (possibly spanning non-addressable devices under
+    jax.distributed) -> this process's local numpy view.
+
+    Replicated arrays -> the single local copy; batch-sharded arrays -> the
+    concatenation of this process's shards (its own rows of the global
+    batch). Reference analog: workers only ever observe their own slice
+    (model.py:244-246 _split_input_slice)."""
+    if not isinstance(x, jax.Array) or x.is_fully_addressable:
+        return np.asarray(x)
+    uniq = {}
+    for s in x.addressable_shards:
+        key = tuple((sl.start, sl.stop) for sl in s.index)
+        uniq.setdefault(key, s)
+    shards = sorted(uniq.values(),
+                    key=lambda s: tuple(sl.start or 0 for sl in s.index))
+    if len(shards) == 1:
+        return np.asarray(shards[0].data)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+
+def _place(value, sharding):
+    """Place host data onto a (possibly multi-process) mesh sharding.
+
+    Under jax.distributed a plain device_put cannot target non-addressable
+    devices; each process contributes its local value as its part of the
+    global array instead (its batch shard, or its replica copy)."""
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sharding,
+                                                      np.asarray(value))
+    return jax.device_put(value, sharding)
+
+
 def _create_kvstore(kvstore, num_device, arg_params):
     """Reference: model.py:126-169 — resolve the kvstore strategy."""
     if kvstore is None:
@@ -207,19 +240,16 @@ class FeedForward(BASE_ESTIMATOR):
             return jax.jit(step, donate_argnums=(0, 1, 2))
         repl = NamedSharding(mesh, P())
         batch_sh = NamedSharding(mesh, P("dp"))
-        in_sh = (repl, repl, repl,
-                 {}, repl, repl)
-        # batch entries sharded on dp; replication for everything else
-        def shard_for_batch(batch):
-            return {k: batch_sh for k in batch}
-
         jitted = jax.jit(step, donate_argnums=(0, 1, 2))
 
         def run(params, opt_state, aux, batch, rng, lr):
-            batch = {k: jax.device_put(v, batch_sh) for k, v in batch.items()}
-            params = jax.device_put(params, repl) if _needs_place(params, mesh) else params
-            opt_state = jax.device_put(opt_state, repl) if _needs_place(opt_state, mesh) else opt_state
-            aux = jax.device_put(aux, repl) if _needs_place(aux, mesh) else aux
+            batch = {k: _place(v, batch_sh) for k, v in batch.items()}
+            if _needs_place(params, mesh):
+                params = jax.tree_util.tree_map(lambda v: _place(v, repl), params)
+            if _needs_place(opt_state, mesh):
+                opt_state = jax.tree_util.tree_map(lambda v: _place(v, repl), opt_state)
+            if _needs_place(aux, mesh):
+                aux = jax.tree_util.tree_map(lambda v: _place(v, repl), aux)
             return jitted(params, opt_state, aux, batch, rng, jnp.float32(lr))
 
         return run
@@ -266,6 +296,20 @@ class FeedForward(BASE_ESTIMATOR):
         kv = _create_kvstore(kvstore, len(self.ctx), self.arg_params)
         num_workers = kv.num_workers if kv is not None else 1
         mesh = self._make_mesh(dist=kv is not None and "dist" in kv.type)
+        if num_workers > 1 and jax.process_count() > 1:
+            # rank 0's initialization wins, like kvstore.init from rank 0
+            # (reference: kvstore_dist.h:49-60) — otherwise per-process RNGs
+            # would silently train diverged replicas.
+            from jax.experimental import multihost_utils
+
+            names = sorted(self.arg_params)
+            aux_ns = sorted(self.aux_params)
+            flat = multihost_utils.broadcast_one_to_all(
+                tuple([self.arg_params[k].asnumpy() for k in names] +
+                      [self.aux_params[k].asnumpy() for k in aux_ns]))
+            for k, v in zip(names + aux_ns, flat):
+                (self.arg_params if k in names else self.aux_params)[k] = \
+                    NDArray(np.asarray(v))
 
         optimizer = self.optimizer
         if isinstance(optimizer, str):
@@ -303,7 +347,8 @@ class FeedForward(BASE_ESTIMATOR):
                     params, opt_state, aux, batch_arrays, rng, lr
                 )
                 num_update += 1
-                eval_metric.update(batch.label, [NDArray(o) for o in outs])
+                eval_metric.update(batch.label,
+                                   [NDArray(_host_local(o)) for o in outs])
                 nbatch += 1
                 if batch_end_callback is not None:
                     p = BatchEndParam(epoch=epoch, nbatch=nbatch, eval_metric=eval_metric)
@@ -316,9 +361,9 @@ class FeedForward(BASE_ESTIMATOR):
             # write state back so callbacks/checkpoints see current values
             # (device_get: sharded -> host, so predict/save work off-mesh)
             for k in param_names:
-                self.arg_params[k] = NDArray(np.asarray(params[k]))
+                self.arg_params[k] = NDArray(_host_local(params[k]))
             for k in aux_names:
-                self.aux_params[k] = NDArray(np.asarray(aux[k]))
+                self.aux_params[k] = NDArray(_host_local(aux[k]))
 
             if eval_data is not None:
                 eval_metric.reset()
@@ -364,8 +409,8 @@ class FeedForward(BASE_ESTIMATOR):
         first = next(iter(params.values())) if params else None
         if first is not None and hasattr(first, "sharding") and \
                 getattr(first.sharding, "num_devices", 1) > 1:
-            params = {k: jnp.asarray(np.asarray(v)) for k, v in params.items()}
-            aux = {k: jnp.asarray(np.asarray(v)) for k, v in aux.items()}
+            params = {k: jnp.asarray(_host_local(v)) for k, v in params.items()}
+            aux = {k: jnp.asarray(_host_local(v)) for k, v in aux.items()}
         eval_iter.reset()
         for batch in eval_iter:
             batch_arrays = {name: arr.data for name, arr in zip(data_names, batch.data)}

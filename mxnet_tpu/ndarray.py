@@ -381,7 +381,7 @@ def waitall():
     XLA executes programs in launch order per device, so synchronizing a
     freshly-launched no-op on every device drains each queue.
     """
-    for dev in jax.devices():
+    for dev in jax.local_devices():
         jax.device_put(np.zeros((), np.int32), dev).block_until_ready()
 
 

@@ -13,7 +13,7 @@ def memory_stats(device=None) -> dict:
     """Per-device allocator stats {bytes_in_use, peak_bytes_in_use, ...}.
 
     Returns zeros when the backend doesn't expose stats (CPU test runs)."""
-    devices = [device] if device is not None else jax.devices()
+    devices = [device] if device is not None else jax.local_devices()
     out = {}
     for d in devices:
         try:

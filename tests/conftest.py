@@ -31,6 +31,12 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running tier "
+        "(reference: tests/python/train + multi-node)")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     """Deterministic tests: reseed numpy and the framework PRNG per test."""
