@@ -1,0 +1,150 @@
+"""Bucketing: variable-length sequence training with a per-bucket compile cache.
+
+Reference counterpart: the "bucketing executor" configuration of
+example/rnn/lstm.py — the reference binds one GraphExecutor per sequence
+length over a shared weight set (SURVEY.md §5 "Long-context / sequence
+parallelism": `lstm_unroll` + bind per seq_len). On TPU the same capability
+is one jit-compiled XLA program per bucket shape, all programs closing over
+the same parameter pytree; the jit cache is the executor cache.
+
+Two pieces:
+
+- ``BucketSentenceIter``: buckets tokenized sentences by length, pads each
+  to its bucket size, and yields ``DataBatch``es tagged with ``bucket_key``
+  plus per-bucket data/label names (``t{i}_data``/``t{i}_label``, matching
+  ``models.lstm_unroll``'s variable naming).
+- ``BucketingFeedForward``: a ``FeedForward`` whose symbol is generated per
+  bucket by ``sym_gen(bucket_key)``; parameters are initialized from the
+  default (largest) bucket and shared across every bucket's compiled step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .io import DataBatch, DataIter
+from .model import FeedForward
+from .ndarray import NDArray
+
+__all__ = ["BucketSentenceIter", "BucketingFeedForward"]
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed language-model iterator.
+
+    Each sentence (list of int token ids) is assigned to the smallest bucket
+    that fits it (longer sentences are dropped, with a count recorded in
+    ``discarded``). Labels are the next-token shift of the data; positions
+    past the sentence end hold ``invalid_label``. Batches are yielded per
+    step as ``t{i}_data`` / ``t{i}_label`` arrays of shape ``(batch,)`` so
+    the same iterator drives the unrolled-symbol path.
+    """
+
+    def __init__(self, sentences, buckets, batch_size, invalid_label=0,
+                 init_states=None, shuffle=True, seed=0):
+        super().__init__()
+        self.buckets = sorted(buckets)
+        self.batch_size = batch_size
+        self.invalid_label = invalid_label
+        # extra non-sequence inputs fed as zeros each batch — the recurrent
+        # initial states (name, shape) pairs, as in the reference's
+        # lstm example where init_c/init_h ride the data iterator
+        self.init_states = list(init_states or [])
+        self.shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+
+        per_bucket = {b: [] for b in self.buckets}
+        self.discarded = 0
+        for sent in sentences:
+            n = len(sent)
+            for b in self.buckets:
+                if n <= b:
+                    per_bucket[b].append(sent)
+                    break
+            else:
+                self.discarded += 1
+
+        # materialize padded (data, label) matrices per bucket
+        self._data = {}
+        for b, sents in per_bucket.items():
+            if not sents:
+                continue
+            mat = np.full((len(sents), b + 1), invalid_label, np.int32)
+            for i, s in enumerate(sents):
+                mat[i, : len(s)] = s
+            self._data[b] = mat
+        self.default_bucket_key = self.buckets[-1]
+        self._plan = []
+        self.reset()
+
+    # iterator-level shapes describe the default (largest) bucket; parameter
+    # initialization against these shapes covers every smaller bucket because
+    # sym_gen shares weights across sequence positions.
+    @property
+    def provide_data(self):
+        return [(f"t{i}_data", (self.batch_size,))
+                for i in range(self.default_bucket_key)] + self.init_states
+
+    @property
+    def provide_label(self):
+        return [(f"t{i}_label", (self.batch_size,))
+                for i in range(self.default_bucket_key)]
+
+    def reset(self):
+        self._plan = []
+        for b, mat in self._data.items():
+            idx = np.arange(len(mat))
+            if self.shuffle:
+                self._rng.shuffle(idx)
+            for start in range(0, len(idx), self.batch_size):
+                self._plan.append((b, idx[start:start + self.batch_size]))
+        if self.shuffle:
+            self._rng.shuffle(self._plan)
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        bucket, rows = self._plan[self._cursor]
+        self._cursor += 1
+        mat = self._data[bucket][rows]
+        pad = self.batch_size - len(mat)
+        if pad:
+            mat = np.concatenate([mat, np.repeat(mat[-1:], pad, axis=0)])
+        batch = DataBatch(
+            data=[NDArray(mat[:, t]) for t in range(bucket)] +
+                 [NDArray(np.zeros(shape, np.float32))
+                  for _, shape in self.init_states],
+            label=[NDArray(mat[:, t + 1]) for t in range(bucket)],
+            pad=pad,
+        )
+        batch.bucket_key = bucket
+        batch.data_names = [f"t{t}_data" for t in range(bucket)] + \
+            [name for name, _ in self.init_states]
+        batch.label_names = [f"t{t}_label" for t in range(bucket)]
+        return batch
+
+
+class BucketingFeedForward(FeedForward):
+    """FeedForward over a family of per-bucket symbols with shared weights.
+
+    ``sym_gen(bucket_key)`` returns the symbol for one bucket; parameters are
+    initialized from ``sym_gen(default_bucket_key)``. ``fit`` compiles one
+    fused train step per distinct bucket shape encountered (lazily) and
+    reuses it for every later batch of that bucket — the TPU-native analog
+    of the reference's executor-per-seq-len bind.
+    """
+
+    def __init__(self, sym_gen, default_bucket_key, **kwargs):
+        self._sym_gen = sym_gen
+        self._bucket_syms = {}
+        self.default_bucket_key = default_bucket_key
+        super().__init__(symbol=self._symbol_for_bucket(default_bucket_key),
+                         **kwargs)
+
+    def _symbol_for_bucket(self, bucket_key):
+        if bucket_key is None:
+            bucket_key = self.default_bucket_key
+        if bucket_key not in self._bucket_syms:
+            self._bucket_syms[bucket_key] = self._sym_gen(bucket_key)
+        return self._bucket_syms[bucket_key]

@@ -21,9 +21,37 @@ def _to_numpy(x):
 
 
 class EvalMetric:
+    # TPU-native device-side accumulation: metrics that set
+    # ``device_supported`` implement ``device_update`` as a traceable pure
+    # function so the trainer can fold the (sum, count) accumulation INTO
+    # the compiled train step and pull scalars once per epoch. The reference
+    # design syncs per batch (".asnumpy() in the metric" is the per-batch
+    # sync point, SURVEY.md §3.1) — on TPU every host pull is a device
+    # round-trip, so per-batch sync would serialize the step stream.
+    device_supported = False
+
     def __init__(self, name):
         self.name = name
         self.reset()
+
+    def device_init(self):
+        """Fresh on-device (sum, count) accumulator. The count is integral:
+        float32 stops counting at 2^24, which a token-level epoch exceeds."""
+        import jax.numpy as jnp
+
+        return (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
+
+    def device_update(self, state, labels, preds):
+        """Traced accumulation: returns the new (sum, count) state."""
+        raise NotImplementedError
+
+    def absorb_device_state(self, state):
+        """Fold a device accumulator into the host-side sums (one pull)."""
+        import jax
+
+        s, n = jax.device_get(state)
+        self.sum_metric += float(s)
+        self.num_inst += float(n)
 
     def reset(self):
         self.num_inst = 0
@@ -46,17 +74,45 @@ class EvalMetric:
             labels = [labels]
         if isinstance(preds, (NDArray, np.ndarray)):
             preds = [preds]
-        if len(labels) != len(preds):
+        # preds may outnumber labels (e.g. lstm_unroll groups BlockGrad'd
+        # final states after the per-step softmaxes); the reference's
+        # metrics zip pairwise, ignoring the extras (metric.py:45).
+        if len(labels) > len(preds):
             raise MXNetError(f"{self.name}: {len(labels)} labels vs {len(preds)} preds")
-        return labels, preds
+        return labels, preds[: len(labels)]
 
 
 @METRICS.register("accuracy")
 class Accuracy(EvalMetric):
     """Classification accuracy via row-argmax (reference: metric.py:45)."""
 
+    device_supported = True
+
     def __init__(self):
         super().__init__("accuracy")
+
+    def device_init(self):
+        import jax.numpy as jnp
+
+        # hit counts are integral too — keep them exact past 2^24
+        return (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+
+    def device_update(self, state, labels, preds):
+        import jax.numpy as jnp
+
+        s, n = state
+        for label, pred in zip(labels, preds[: len(labels)]):
+            label = label.astype(jnp.int32).ravel()
+            if pred.ndim > 2:
+                pred3 = pred.reshape(pred.shape[0], pred.shape[1], -1)
+                s += jnp.sum(jnp.argmax(pred3, axis=1).ravel() ==
+                             label).astype(jnp.int32)
+                n += label.size
+            else:
+                s += jnp.sum(jnp.argmax(pred, axis=-1) ==
+                             label).astype(jnp.int32)
+                n += pred.shape[0]
+        return (s, n)
 
     def update(self, labels, preds):
         labels, preds = self._as_lists(labels, preds)
@@ -91,8 +147,20 @@ class TopKAccuracy(EvalMetric):
 
 @METRICS.register("mae")
 class MAE(EvalMetric):
+    device_supported = True
+
     def __init__(self):
         super().__init__("mae")
+
+    def device_update(self, state, labels, preds):
+        import jax.numpy as jnp
+
+        s, n = state
+        for label, pred in zip(labels, preds[: len(labels)]):
+            s += jnp.mean(jnp.abs(label.reshape(pred.shape).astype(jnp.float32)
+                                  - pred.astype(jnp.float32)))
+            n += 1
+        return (s, n)
 
     def update(self, labels, preds):
         labels, preds = self._as_lists(labels, preds)
@@ -104,8 +172,20 @@ class MAE(EvalMetric):
 
 @METRICS.register("mse")
 class MSE(EvalMetric):
+    device_supported = True
+
     def __init__(self):
         super().__init__("mse")
+
+    def device_update(self, state, labels, preds):
+        import jax.numpy as jnp
+
+        s, n = state
+        for label, pred in zip(labels, preds[: len(labels)]):
+            s += jnp.mean((label.reshape(pred.shape).astype(jnp.float32) -
+                           pred.astype(jnp.float32)) ** 2)
+            n += 1
+        return (s, n)
 
     def update(self, labels, preds):
         labels, preds = self._as_lists(labels, preds)
@@ -130,9 +210,22 @@ class RMSE(EvalMetric):
 
 @METRICS.register("ce")
 class CrossEntropy(EvalMetric):
+    device_supported = True
+
     def __init__(self, eps=1e-8):
         self.eps = eps
         super().__init__("cross-entropy")
+
+    def device_update(self, state, labels, preds):
+        import jax.numpy as jnp
+
+        s, n = state
+        for label, pred in zip(labels, preds[: len(labels)]):
+            lab = label.astype(jnp.int32).ravel()
+            prob = pred.astype(jnp.float32)[jnp.arange(lab.shape[0]), lab]
+            s += jnp.sum(-jnp.log(prob + self.eps))
+            n += lab.shape[0]
+        return (s, n)
 
     def update(self, labels, preds):
         labels, preds = self._as_lists(labels, preds)

@@ -168,8 +168,7 @@ class FeedForward(BASE_ESTIMATOR):
         self.begin_epoch = begin_epoch
         self.compute_dtype = compute_dtype
         self.kwargs = dict(kwargs)
-        self._pred_fn = None
-        self._train_fns = {}
+        self._pred_fns = {}
 
     # -- parameter init -------------------------------------------------------
     def _init_params(self, input_shapes, overwrite=False):
@@ -213,11 +212,19 @@ class FeedForward(BASE_ESTIMATOR):
         return Mesh(np.array(devs), ("dp",))
 
     # -- the fused train step -------------------------------------------------
-    def _build_train_step(self, data_names, label_names, optimizer, mesh):
-        graph_fn = _build_graph_fn(self.symbol, is_train=True)
+    def _symbol_for_bucket(self, bucket_key):
+        """Symbol to compile for one bucket key; the base trainer has a
+        single symbol (BucketingFeedForward generates one per key)."""
+        del bucket_key
+        return self.symbol
+
+    def _build_train_step(self, data_names, label_names, optimizer, mesh,
+                          symbol=None, metric_update=None):
+        graph_fn = _build_graph_fn(symbol if symbol is not None else self.symbol,
+                                   is_train=True)
         compute_dtype = self.compute_dtype
 
-        def step(params, opt_state, aux, batch, rng, lr):
+        def step(params, opt_state, aux, batch, rng, lr, mstate):
             def loss_fn(p):
                 if compute_dtype is not None:
                     p_c = {k: (v.astype(compute_dtype)
@@ -234,15 +241,21 @@ class FeedForward(BASE_ESTIMATOR):
 
             grads, (outs, new_aux) = jax.grad(loss_fn, has_aux=True)(params)
             new_params, new_opt_state = optimizer.apply(params, grads, opt_state, lr)
-            return new_params, new_opt_state, new_aux, outs
+            if metric_update is not None:
+                # fold metric accumulation into the same XLA program — no
+                # per-batch host pull (every pull is a device round-trip)
+                labels = [batch[n] for n in label_names]
+                mstate = metric_update(
+                    mstate, labels, [o.astype(jnp.float32) for o in outs])
+            return new_params, new_opt_state, new_aux, outs, mstate
 
         if mesh is None:
-            return jax.jit(step, donate_argnums=(0, 1, 2))
+            return jax.jit(step, donate_argnums=(0, 1, 2, 6))
         repl = NamedSharding(mesh, P())
         batch_sh = NamedSharding(mesh, P("dp"))
-        jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+        jitted = jax.jit(step, donate_argnums=(0, 1, 2, 6))
 
-        def run(params, opt_state, aux, batch, rng, lr):
+        def run(params, opt_state, aux, batch, rng, lr, mstate):
             batch = {k: _place(v, batch_sh) for k, v in batch.items()}
             if _needs_place(params, mesh):
                 params = jax.tree_util.tree_map(lambda v: _place(v, repl), params)
@@ -250,12 +263,16 @@ class FeedForward(BASE_ESTIMATOR):
                 opt_state = jax.tree_util.tree_map(lambda v: _place(v, repl), opt_state)
             if _needs_place(aux, mesh):
                 aux = jax.tree_util.tree_map(lambda v: _place(v, repl), aux)
-            return jitted(params, opt_state, aux, batch, rng, jnp.float32(lr))
+            if _needs_place(mstate, mesh):
+                mstate = jax.tree_util.tree_map(lambda v: _place(v, repl), mstate)
+            return jitted(params, opt_state, aux, batch, rng, jnp.float32(lr),
+                          mstate)
 
         return run
 
-    def _build_pred_step(self, mesh):
-        graph_fn = _build_graph_fn(self.symbol, is_train=False)
+    def _build_pred_step(self, mesh, symbol=None):
+        graph_fn = _build_graph_fn(symbol if symbol is not None else self.symbol,
+                                   is_train=False)
         compute_dtype = self.compute_dtype
 
         def step(params, aux, batch):
@@ -325,35 +342,60 @@ class FeedForward(BASE_ESTIMATOR):
         params = {k: jnp.asarray(self.arg_params[k].asnumpy()) for k in param_names}
         aux = {k: jnp.asarray(self.aux_params[k].asnumpy()) for k in aux_names}
         opt_state = optimizer.init_state_tree(params)
-        train_step = self._build_train_step(data_names, label_names, optimizer, mesh)
+        # One compiled step per bucket key (None = the single-symbol case);
+        # all entries share the same live param/opt-state pytrees.
+        train_steps = {}
 
         eval_metric = metric_mod.create(eval_metric)
+        # Device-resident metric accumulation whenever the metric supports it
+        # and nothing needs per-batch host values: the (sum, count) scalars
+        # live on device inside the train step and are pulled once per epoch.
+        # With a batch_end_callback (e.g. Speedometer reading the metric) we
+        # keep the reference's per-batch host update semantics.
+        use_device_metric = (eval_metric.device_supported
+                             and batch_end_callback is None)
+        metric_update = eval_metric.device_update if use_device_metric else None
         num_update = 0
         for epoch in range(self.begin_epoch, self.num_epoch or 1):
             tic = time.time()
             eval_metric.reset()
+            mstate = eval_metric.device_init()
             nbatch = 0
             train_data.reset()
             for batch in train_data:
+                bkey = getattr(batch, "bucket_key", None)
+                b_dnames = getattr(batch, "data_names", data_names)
+                b_lnames = getattr(batch, "label_names", label_names)
+                if bkey not in train_steps:
+                    train_steps[bkey] = self._build_train_step(
+                        b_dnames, b_lnames, optimizer, mesh,
+                        symbol=self._symbol_for_bucket(bkey),
+                        metric_update=metric_update)
+                train_step = train_steps[bkey]
                 batch_arrays = {}
-                for name, arr in zip(data_names, batch.data):
+                for name, arr in zip(b_dnames, batch.data):
                     batch_arrays[name] = arr.data
-                for name, arr in zip(label_names, batch.label):
+                for name, arr in zip(b_lnames, batch.label):
                     batch_arrays[name] = arr.data
                 rng = random_mod.next_key()
                 lr = optimizer._get_lr()
                 optimizer.num_update = num_update
-                params, opt_state, aux, outs = train_step(
-                    params, opt_state, aux, batch_arrays, rng, lr
+                params, opt_state, aux, outs, mstate = train_step(
+                    params, opt_state, aux, batch_arrays, rng, lr, mstate
                 )
                 num_update += 1
-                eval_metric.update(batch.label,
-                                   [NDArray(_host_local(o)) for o in outs])
+                if not use_device_metric:
+                    eval_metric.update(
+                        batch.label,
+                        [NDArray(_host_local(o))
+                         for o in outs[: len(batch.label)]])
                 nbatch += 1
                 if batch_end_callback is not None:
                     p = BatchEndParam(epoch=epoch, nbatch=nbatch, eval_metric=eval_metric)
                     for cb in _as_list(batch_end_callback):
                         cb(p)
+            if use_device_metric:
+                eval_metric.absorb_device_state(mstate)
             name, value = eval_metric.get()
             logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
             logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
@@ -378,10 +420,11 @@ class FeedForward(BASE_ESTIMATOR):
                     cb(epoch, self.symbol, self.arg_params, self.aux_params)
         return self
 
-    def _fill_missing_args(self, params, batch_arrays):
+    def _fill_missing_args(self, params, batch_arrays, symbol=None):
         """Zero-fill label args absent at inference time (forward of loss
         heads ignores labels; reference predict binds them as zeros too)."""
-        arg_names = self.symbol.list_arguments()
+        symbol = symbol if symbol is not None else self.symbol
+        arg_names = symbol.list_arguments()
         missing = [n for n in arg_names
                    if n not in params and n not in batch_arrays]
         if not missing:
@@ -389,22 +432,23 @@ class FeedForward(BASE_ESTIMATOR):
         known = {k: tuple(v.shape) for k, v in batch_arrays.items()}
         known.update({k: tuple(v.shape) for k, v in params.items()
                       if k in arg_names})
-        arg_shapes, _, _ = self.symbol.infer_shape(**known)
+        arg_shapes, _, _ = symbol.infer_shape(**known)
         shape_of = dict(zip(arg_names, arg_shapes))
         out = dict(batch_arrays)
         for n in missing:
             out[n] = jnp.zeros(shape_of[n], jnp.float32)
         return out
 
-    def _get_pred_step(self):
+    def _get_pred_step(self, bucket_key=None):
         """Cached jitted forward (rebuilding per call would recompile the
-        whole XLA program every epoch/predict)."""
-        if self._pred_fn is None:
-            self._pred_fn = self._build_pred_step(None)
-        return self._pred_fn
+        whole XLA program every epoch/predict). One cache entry per bucket
+        key — the jit cache is the reference's executor-per-seq-len cache."""
+        if bucket_key not in self._pred_fns:
+            self._pred_fns[bucket_key] = self._build_pred_step(
+                None, self._symbol_for_bucket(bucket_key))
+        return self._pred_fns[bucket_key]
 
     def _eval(self, eval_iter, eval_metric, params, aux, data_names, label_names):
-        pred = self._get_pred_step()
         # params may be mesh-sharded during fit; pull to the default device
         first = next(iter(params.values())) if params else None
         if first is not None and hasattr(first, "sharding") and \
@@ -413,8 +457,12 @@ class FeedForward(BASE_ESTIMATOR):
             aux = {k: jnp.asarray(_host_local(v)) for k, v in aux.items()}
         eval_iter.reset()
         for batch in eval_iter:
-            batch_arrays = {name: arr.data for name, arr in zip(data_names, batch.data)}
-            batch_arrays = self._fill_missing_args(params, batch_arrays)
+            bkey = getattr(batch, "bucket_key", None)
+            pred = self._get_pred_step(bkey)
+            names = getattr(batch, "data_names", data_names)
+            batch_arrays = {name: arr.data for name, arr in zip(names, batch.data)}
+            batch_arrays = self._fill_missing_args(
+                params, batch_arrays, symbol=self._symbol_for_bucket(bkey))
             outs = pred(params, aux, batch_arrays)
             pad = batch.pad
             outs = [NDArray(o[: o.shape[0] - pad] if pad else o) for o in outs]
@@ -433,12 +481,15 @@ class FeedForward(BASE_ESTIMATOR):
             raise MXNetError("model has no parameters; fit() or load first")
         params = {k: v.data for k, v in self.arg_params.items()}
         aux = {k: v.data for k, v in (self.aux_params or {}).items()}
-        pred = self._get_pred_step()
         chunks = None
         data_iter.reset()
         for batch in data_iter:
-            batch_arrays = {name: arr.data for name, arr in zip(data_names, batch.data)}
-            batch_arrays = self._fill_missing_args(params, batch_arrays)
+            bkey = getattr(batch, "bucket_key", None)
+            pred = self._get_pred_step(bkey)
+            names = getattr(batch, "data_names", data_names)
+            batch_arrays = {name: arr.data for name, arr in zip(names, batch.data)}
+            batch_arrays = self._fill_missing_args(
+                params, batch_arrays, symbol=self._symbol_for_bucket(bkey))
             outs = pred(params, aux, batch_arrays)
             pad = batch.pad
             outs = [np.asarray(o[: o.shape[0] - pad] if pad else o) for o in outs]
