@@ -1,0 +1,63 @@
+# R user layer over the .C shim (reference capability: R-package/R/ — here
+# the deployment slice: load an exported .mxtpu bundle and run forward).
+#
+# Example:
+#   pred <- mx.pred.create("model.mxtpu")
+#   mx.pred.set.input(pred, "data", batch)      # array, R dim() order
+#   mx.pred.forward(pred)
+#   probs <- mx.pred.get.output(pred, 1)
+#   mx.pred.free(pred)
+
+mx.pred.create <- function(bundle_path) {
+  r <- .C("mxtpu_r_create", as.character(bundle_path),
+          id = integer(1), status = integer(1))
+  if (r$status != 0) stop("mxtpu: ", .mx.last.error())
+  structure(r$id, class = "mxtpu.predictor")
+}
+
+.mx.last.error <- function() {
+  buf <- paste(rep(" ", 512), collapse = "")
+  r <- .C("mxtpu_r_last_error", msg = as.character(buf), as.integer(512))
+  r$msg
+}
+
+mx.pred.set.input <- function(pred, name, value) {
+  # R arrays are column-major; the runtime wants row-major (C) order, so
+  # transpose by reversing dims, like the reference R binding did.
+  dims <- dim(value)
+  if (is.null(dims)) dims <- length(value)
+  value <- aperm(array(value, dims), rev(seq_along(dims)))
+  r <- .C("mxtpu_r_set_input", as.integer(pred), as.character(name),
+          as.double(value), as.integer(rev(dims)), as.integer(length(dims)),
+          status = integer(1))
+  if (r$status != 0) stop("mxtpu: ", .mx.last.error())
+  invisible(NULL)
+}
+
+mx.pred.forward <- function(pred) {
+  r <- .C("mxtpu_r_forward", as.integer(pred), status = integer(1))
+  if (r$status != 0) stop("mxtpu: ", .mx.last.error())
+  invisible(NULL)
+}
+
+mx.pred.num.outputs <- function(pred) {
+  .C("mxtpu_r_num_outputs", as.integer(pred), n = integer(1))$n
+}
+
+mx.pred.get.output <- function(pred, index = 1) {
+  s <- .C("mxtpu_r_output_shape", as.integer(pred), as.integer(index - 1),
+          ndim = integer(1), shape = integer(8))
+  if (s$ndim < 0) stop("mxtpu: bad output index")
+  shape <- s$shape[seq_len(s$ndim)]
+  size <- prod(shape)
+  r <- .C("mxtpu_r_get_output", as.integer(pred), as.integer(index - 1),
+          out = double(size), as.integer(size), status = integer(1))
+  if (r$status != 0) stop("mxtpu: ", .mx.last.error())
+  # back to column-major
+  aperm(array(r$out, rev(shape)), rev(seq_along(shape)))
+}
+
+mx.pred.free <- function(pred) {
+  .C("mxtpu_r_free", as.integer(pred))
+  invisible(NULL)
+}

@@ -243,10 +243,13 @@ class FeedForward(BASE_ESTIMATOR):
             new_params, new_opt_state = optimizer.apply(params, grads, opt_state, lr)
             if metric_update is not None:
                 # fold metric accumulation into the same XLA program — no
-                # per-batch host pull (every pull is a device round-trip)
+                # per-batch host pull (every pull is a device round-trip) —
+                # and drop the forward outputs from the program: nothing
+                # reads them, so XLA needn't materialize them every step
                 labels = [batch[n] for n in label_names]
                 mstate = metric_update(
                     mstate, labels, [o.astype(jnp.float32) for o in outs])
+                outs = ()
             return new_params, new_opt_state, new_aux, outs, mstate
 
         if mesh is None:
@@ -360,6 +363,11 @@ class FeedForward(BASE_ESTIMATOR):
             tic = time.time()
             eval_metric.reset()
             mstate = eval_metric.device_init()
+            # int32 device counters wrap at 2^31; label counts per batch are
+            # statically known, so absorb the accumulator mid-epoch before
+            # the running count could overflow (one extra pull per ~1e9
+            # instances — negligible)
+            pending_inst = 0
             nbatch = 0
             train_data.reset()
             for batch in train_data:
@@ -384,7 +392,14 @@ class FeedForward(BASE_ESTIMATOR):
                     params, opt_state, aux, batch_arrays, rng, lr, mstate
                 )
                 num_update += 1
-                if not use_device_metric:
+                if use_device_metric:
+                    pending_inst += sum(
+                        int(np.prod(a.shape)) for a in batch.label)
+                    if pending_inst > 2 ** 30:
+                        eval_metric.absorb_device_state(mstate)
+                        mstate = eval_metric.device_init()
+                        pending_inst = 0
+                else:
                     eval_metric.update(
                         batch.label,
                         [NDArray(_host_local(o))

@@ -24,7 +24,15 @@ _lock = threading.Lock()
 _lib = None
 _tried = False
 
-__all__ = ["NativePredictor", "get_predict_lib"]
+__all__ = ["NativePredictor", "get_predict_lib", "load_lib"]
+
+
+def load_lib(path):
+    """Load and configure a predict library from an explicit .so path
+    (used by the amalgamation build's self-test)."""
+    lib = ctypes.CDLL(path)
+    _configure(lib)
+    return lib
 
 
 def get_predict_lib():
@@ -46,27 +54,29 @@ def get_predict_lib():
             if not os.path.exists(_SO):
                 return None
         try:
-            lib = ctypes.CDLL(_SO)
+            _lib = load_lib(_SO)
         except OSError:
             return None
-        lib.mxtpu_pred_create.restype = ctypes.c_void_p
-        lib.mxtpu_pred_create.argtypes = [ctypes.c_char_p]
-        lib.mxtpu_pred_last_error.restype = ctypes.c_char_p
-        lib.mxtpu_pred_set_input.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
-        lib.mxtpu_pred_forward.argtypes = [ctypes.c_void_p]
-        lib.mxtpu_pred_num_outputs.argtypes = [ctypes.c_void_p]
-        lib.mxtpu_pred_output_ndim.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.mxtpu_pred_output_shape.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)]
-        lib.mxtpu_pred_get_output.restype = ctypes.c_int64
-        lib.mxtpu_pred_get_output.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int64]
-        lib.mxtpu_pred_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
         return _lib
+
+
+def _configure(lib):
+    lib.mxtpu_pred_create.restype = ctypes.c_void_p
+    lib.mxtpu_pred_create.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_pred_last_error.restype = ctypes.c_char_p
+    lib.mxtpu_pred_set_input.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.mxtpu_pred_forward.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_pred_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_pred_output_ndim.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.mxtpu_pred_output_shape.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)]
+    lib.mxtpu_pred_get_output.restype = ctypes.c_int64
+    lib.mxtpu_pred_get_output.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64]
+    lib.mxtpu_pred_free.argtypes = [ctypes.c_void_p]
 
 
 class NativePredictor:
@@ -80,8 +90,8 @@ class NativePredictor:
         probs = pred.get_output(0)              # MXPredGetOutput
     """
 
-    def __init__(self, bundle_path: str):
-        lib = get_predict_lib()
+    def __init__(self, bundle_path: str, lib=None):
+        lib = lib if lib is not None else get_predict_lib()
         if lib is None:
             raise RuntimeError("native predict library unavailable")
         self._lib = lib

@@ -1,0 +1,51 @@
+"""Amalgamation test (reference: amalgamation/ single-file predict build):
+generate the one-file source, compile it fresh, and run a bundle through it."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from amalgamation.amalgamation import amalgamate  # noqa: E402
+
+import mxnet_tpu.symbol as S  # noqa: E402
+from mxnet_tpu import ndarray as nd  # noqa: E402
+from mxnet_tpu.native import predict as native_predict  # noqa: E402
+from mxnet_tpu.predictor import Predictor  # noqa: E402
+
+
+@pytest.mark.slow
+def test_amalgamated_predictor_roundtrip(tmp_path):
+    src = amalgamate(output=str(tmp_path / "mxtpu_predict-all.cc"))
+    text = open(src).read()
+    assert "mxtpu_pred_create" in text
+    assert '#include "' not in text  # fully inlined
+
+    so = str(tmp_path / "libamalg.so")
+    subprocess.run(["g++", "-O1", "-std=c++17", "-shared", "-fPIC", src,
+                    "-lz", "-o", so], check=True)
+    lib = native_predict.load_lib(so)
+
+    x = S.Variable("data")
+    h = S.Activation(S.FullyConnected(data=x, num_hidden=16, name="fc1"),
+                     act_type="relu")
+    out = S.SoftmaxOutput(S.FullyConnected(data=h, num_hidden=4, name="fc2"),
+                          name="softmax")
+    rng = np.random.RandomState(0)
+    params = {n: nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+              for n, s in [("fc1_weight", (16, 8)), ("fc1_bias", (16,)),
+                           ("fc2_weight", (4, 16)), ("fc2_bias", (4,))]}
+    pred = Predictor(out, params, {}, input_names=["data"])
+    inp = rng.randn(3, 8).astype(np.float32)
+    pred.forward(data=inp)
+    expected = pred.get_output(0)
+
+    bundle = str(tmp_path / "m.mxtpu")
+    pred.export(bundle)
+    npred = native_predict.NativePredictor(bundle, lib=lib)
+    npred.forward(data=inp)
+    np.testing.assert_allclose(npred.get_output(0), expected,
+                               atol=2e-4, rtol=1e-3)

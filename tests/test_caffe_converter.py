@@ -1,0 +1,140 @@
+"""Caffe converter tests (reference: tools/caffe_converter/ — prototxt ->
+Symbol + weight conversion, here dependency-free)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from caffe_converter import convert_weights, load_npz_blobs, proto_to_symbol  # noqa: E402
+from caffe_converter.prototxt import first, parse  # noqa: E402
+
+LENET_PROTOTXT = """
+name: "LeNet"
+input: "data"
+input_dim: 2
+input_dim: 1
+input_dim: 28
+input_dim: 28
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 5 stride: 1 }
+}
+layer {
+  name: "relu1"
+  type: "ReLU"
+  bottom: "conv1"
+  top: "conv1"
+}
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  bottom: "pool1"
+  top: "ip1"
+  inner_product_param { num_output: 10 }
+}
+layer {
+  name: "prob"
+  type: "Softmax"
+  bottom: "ip1"
+  top: "prob"
+}
+"""
+
+
+def test_prototxt_parser():
+    msg = parse(LENET_PROTOTXT)
+    assert first(msg, "name") == "LeNet"
+    assert msg["input_dim"] == [2, 1, 28, 28]
+    layers = msg["layer"]
+    assert len(layers) == 5
+    conv = first(layers[0], "convolution_param")
+    assert first(conv, "num_output") == 8
+    assert first(first(layers[2], "pooling_param"), "pool") == "MAX"
+
+
+def test_proto_to_symbol_shapes():
+    symbol, input_shapes = proto_to_symbol(LENET_PROTOTXT)
+    assert input_shapes["data"] == (2, 1, 28, 28)
+    args = symbol.list_arguments()
+    for name in ("conv1_weight", "conv1_bias", "ip1_weight", "ip1_bias"):
+        assert name in args, args
+    arg_shapes, out_shapes, _ = symbol.infer_shape(data=(2, 1, 28, 28))
+    shape_of = dict(zip(args, arg_shapes))
+    assert shape_of["conv1_weight"] == (8, 1, 5, 5)
+    assert shape_of["ip1_weight"] == (10, 8 * 12 * 12)
+    assert out_shapes[0] == (2, 10)
+
+
+def test_weight_conversion_and_forward(tmp_path):
+    symbol, _ = proto_to_symbol(LENET_PROTOTXT)
+    rng = np.random.RandomState(0)
+    blobs = {
+        "conv1": [rng.randn(8, 1, 5, 5).astype(np.float32),
+                  rng.randn(8).astype(np.float32)],
+        "ip1": [rng.randn(10, 8 * 12 * 12).astype(np.float32),
+                rng.randn(10).astype(np.float32)],
+    }
+    npz = tmp_path / "blobs.npz"
+    np.savez(npz, **{f"{l}/{i}": a for l, arrs in blobs.items()
+                     for i, a in enumerate(arrs)})
+    arg_params = convert_weights(load_npz_blobs(str(npz)), symbol)
+    assert set(arg_params) == {"conv1_weight", "conv1_bias",
+                               "ip1_weight", "ip1_bias"}
+
+    exe = symbol.simple_bind(mx.cpu(), data=(2, 1, 28, 28))
+    for k, v in arg_params.items():
+        exe.arg_dict[k][:] = v.asnumpy()
+    x = rng.rand(2, 1, 28, 28).astype(np.float32)
+    out = exe.forward(data=mx.nd.array(x))[0].asnumpy()
+    # numpy replica of the conv->relu->pool->fc->softmax pipeline
+    from numpy.lib.stride_tricks import sliding_window_view
+
+    conv = np.zeros((2, 8, 24, 24), np.float32)
+    win = sliding_window_view(x, (5, 5), axis=(2, 3))  # (2,1,24,24,5,5)
+    for o in range(8):
+        conv[:, o] = np.einsum("nchwkl,ckl->nhw", win, blobs["conv1"][0][o]) \
+            + blobs["conv1"][1][o]
+    relu = np.maximum(conv, 0)
+    pooled = relu.reshape(2, 8, 12, 2, 12, 2).max(axis=(3, 5))
+    logits = pooled.reshape(2, -1) @ blobs["ip1"][0].T + blobs["ip1"][1]
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    probs = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(out, probs, rtol=1e-4, atol=1e-5)
+
+
+def test_convert_weights_missing_layer_raises():
+    symbol, _ = proto_to_symbol(LENET_PROTOTXT)
+    with pytest.raises(ValueError, match="ip1"):
+        convert_weights({"conv1": [np.zeros((8, 1, 5, 5), np.float32),
+                                   np.zeros(8, np.float32)]}, symbol)
+
+
+def test_v1_layer_types_and_split_concat():
+    proto = """
+    input: "data"
+    input_shape { dim: 1 dim: 4 dim: 8 dim: 8 }
+    layers { name: "sp" type: 22 bottom: "data" top: "a" top: "b" }
+    layers { name: "c1" type: 4 bottom: "a" top: "c1"
+             convolution_param { num_output: 4 kernel_size: 1 } }
+    layers { name: "cat" type: 3 bottom: "c1" bottom: "b" top: "cat" }
+    layers { name: "loss" type: 21 bottom: "cat" top: "loss" }
+    """
+    symbol, shapes = proto_to_symbol(proto)
+    assert shapes["data"] == (1, 4, 8, 8)
+    _, out_shapes, _ = symbol.infer_shape(data=(1, 4, 8, 8))
+    assert out_shapes[0] == (1, 8, 8, 8)  # concat of 4+4 channels

@@ -1,0 +1,112 @@
+"""R-binding shim test (reference: R-package/): the shim exposes the predict
+ABI through the .C calling convention (plain pointers, id-registry handles),
+so it can be verified without an R installation by calling it via ctypes
+exactly the way R's .C() would."""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu.symbol as S
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.predictor import Predictor
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture(scope="module")
+def shim(tmp_path_factory):
+    so = str(tmp_path_factory.mktemp("rshim") / "mxtpu_rshim.so")
+    try:
+        subprocess.run(
+            ["g++", "-O1", "-std=c++17", "-shared", "-fPIC",
+             os.path.join(ROOT, "R-package", "src", "mxtpu_shim.cc"),
+             os.path.join(ROOT, "mxnet_tpu", "native", "mxtpu_predict.cc"),
+             "-lz", "-o", so], check=True, capture_output=True)
+    except subprocess.CalledProcessError as e:
+        pytest.fail(f"shim build failed: {e.stderr.decode()[-2000:]}")
+    return ctypes.CDLL(so)
+
+
+def _int(v):
+    return ctypes.byref(ctypes.c_int(v))
+
+
+def test_r_shim_roundtrip(shim, tmp_path):
+    x = S.Variable("data")
+    out = S.SoftmaxOutput(S.FullyConnected(data=x, num_hidden=3, name="fc"),
+                          name="softmax")
+    rng = np.random.RandomState(0)
+    params = {"fc_weight": nd.array(rng.randn(3, 5).astype(np.float32)),
+              "fc_bias": nd.array(rng.randn(3).astype(np.float32))}
+    pred = Predictor(out, params, {}, input_names=["data"])
+    inp = rng.randn(2, 5).astype(np.float32)
+    pred.forward(data=inp)
+    expected = pred.get_output(0)
+    bundle = str(tmp_path / "m.mxtpu")
+    pred.export(bundle)
+
+    # create — .C passes scalars as pointers, strings as char**
+    path = ctypes.c_char_p(bundle.encode())
+    pid, status = ctypes.c_int(0), ctypes.c_int(0)
+    shim.mxtpu_r_create(ctypes.byref(path), ctypes.byref(pid),
+                        ctypes.byref(status))
+    assert status.value == 0, status.value
+    assert pid.value > 0
+
+    # set_input with R's doubles
+    data = inp.astype(np.float64)
+    name = ctypes.c_char_p(b"data")
+    shape = (ctypes.c_int * 2)(2, 5)
+    shim.mxtpu_r_set_input(
+        ctypes.byref(ctypes.c_int(pid.value)), ctypes.byref(name),
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), shape,
+        _int(2), ctypes.byref(status))
+    assert status.value == 0
+
+    shim.mxtpu_r_forward(ctypes.byref(ctypes.c_int(pid.value)),
+                         ctypes.byref(status))
+    assert status.value == 0
+
+    n = ctypes.c_int(0)
+    shim.mxtpu_r_num_outputs(ctypes.byref(ctypes.c_int(pid.value)),
+                             ctypes.byref(n))
+    assert n.value == 1
+
+    ndim = ctypes.c_int(0)
+    oshape = (ctypes.c_int * 8)()
+    shim.mxtpu_r_output_shape(ctypes.byref(ctypes.c_int(pid.value)),
+                              _int(0), ctypes.byref(ndim), oshape)
+    assert ndim.value == 2
+    assert tuple(oshape[:2]) == (2, 3)
+
+    out_buf = np.zeros(6, np.float64)
+    shim.mxtpu_r_get_output(
+        ctypes.byref(ctypes.c_int(pid.value)), _int(0),
+        out_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _int(6), ctypes.byref(status))
+    assert status.value == 0
+    np.testing.assert_allclose(out_buf.reshape(2, 3), expected,
+                               atol=2e-4, rtol=1e-3)
+
+    shim.mxtpu_r_free(ctypes.byref(ctypes.c_int(pid.value)))
+    # bad handle after free
+    shim.mxtpu_r_forward(ctypes.byref(ctypes.c_int(pid.value)),
+                         ctypes.byref(status))
+    assert status.value == -2
+
+
+def test_r_shim_bad_bundle(shim, tmp_path):
+    bad = str(tmp_path / "nope.mxtpu")
+    path = ctypes.c_char_p(bad.encode())
+    pid, status = ctypes.c_int(0), ctypes.c_int(0)
+    shim.mxtpu_r_create(ctypes.byref(path), ctypes.byref(pid),
+                        ctypes.byref(status))
+    assert status.value == -1
+    buf = ctypes.create_string_buffer(512)
+    msg = ctypes.cast(buf, ctypes.c_char_p)
+    shim.mxtpu_r_last_error(ctypes.byref(msg), _int(512))
+    assert buf.value  # error message populated

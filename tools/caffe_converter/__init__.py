@@ -1,0 +1,23 @@
+"""Caffe → mxnet_tpu converter (reference: tools/caffe_converter/).
+
+Unlike the reference (which imports the caffe python package to parse
+prototxt/caffemodel), this converter is dependency-free: ``prototxt.py`` is
+a pure-Python protobuf text-format parser, ``convert_symbol`` maps parsed
+layers onto the Symbol API, and ``convert_model`` loads weights from an
+``.npz`` blob dump (or, when a caffe installation is present, directly from
+a ``.caffemodel``).
+"""
+
+import os as _os
+import sys as _sys
+
+try:
+    import mxnet_tpu  # noqa: F401
+except ImportError:  # running the CLI from tools/ without an install
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                      _os.pardir, _os.pardir))
+
+from .convert_symbol import proto_to_symbol
+from .convert_model import convert_weights, load_npz_blobs
+
+__all__ = ["proto_to_symbol", "convert_weights", "load_npz_blobs"]
