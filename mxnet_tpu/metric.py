@@ -53,6 +53,16 @@ class EvalMetric:
         self.sum_metric += float(s)
         self.num_inst += float(n)
 
+    def device_key(self):
+        """Hashable identity of the device_update computation — the compile
+        cache must distinguish instances whose hyperparameters (e.g.
+        CrossEntropy's eps) change the traced math."""
+        hyper = tuple(sorted(
+            (k, v) for k, v in self.__dict__.items()
+            if k not in ("name", "num_inst", "sum_metric")
+            and isinstance(v, (int, float, str, bool))))
+        return (type(self).__name__, hyper)
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0

@@ -169,6 +169,7 @@ class FeedForward(BASE_ESTIMATOR):
         self.compute_dtype = compute_dtype
         self.kwargs = dict(kwargs)
         self._pred_fns = {}
+        self._eval_fns = {}
 
     # -- parameter init -------------------------------------------------------
     def _init_params(self, input_shapes, overwrite=False):
@@ -212,6 +213,31 @@ class FeedForward(BASE_ESTIMATOR):
         return Mesh(np.array(devs), ("dp",))
 
     # -- the fused train step -------------------------------------------------
+    class _DeviceMetricAccum:
+        """Host-side guard around a device metric accumulator: counts label
+        instances per batch (statically known from shapes) and absorbs the
+        on-device (sum, count) into the metric before its int32 counters
+        could wrap — one extra pull per ~1e9 instances."""
+
+        _FLUSH_AT = 2 ** 30
+
+        def __init__(self, metric):
+            self.metric = metric
+            self.state = metric.device_init()
+            self._pending = 0
+
+        def after_batch(self, labels):
+            self._pending += sum(int(np.prod(l.shape)) for l in labels)
+            if self._pending > self._FLUSH_AT:
+                self.metric.absorb_device_state(self.state)
+                self.state = self.metric.device_init()
+                self._pending = 0
+
+        def finish(self):
+            self.metric.absorb_device_state(self.state)
+            self.state = self.metric.device_init()
+            self._pending = 0
+
     def _symbol_for_bucket(self, bucket_key):
         """Symbol to compile for one bucket key; the base trainer has a
         single symbol (BucketingFeedForward generates one per key)."""
@@ -362,12 +388,7 @@ class FeedForward(BASE_ESTIMATOR):
         for epoch in range(self.begin_epoch, self.num_epoch or 1):
             tic = time.time()
             eval_metric.reset()
-            mstate = eval_metric.device_init()
-            # int32 device counters wrap at 2^31; label counts per batch are
-            # statically known, so absorb the accumulator mid-epoch before
-            # the running count could overflow (one extra pull per ~1e9
-            # instances — negligible)
-            pending_inst = 0
+            maccum = self._DeviceMetricAccum(eval_metric)
             nbatch = 0
             train_data.reset()
             for batch in train_data:
@@ -388,17 +409,12 @@ class FeedForward(BASE_ESTIMATOR):
                 rng = random_mod.next_key()
                 lr = optimizer._get_lr()
                 optimizer.num_update = num_update
-                params, opt_state, aux, outs, mstate = train_step(
-                    params, opt_state, aux, batch_arrays, rng, lr, mstate
+                params, opt_state, aux, outs, maccum.state = train_step(
+                    params, opt_state, aux, batch_arrays, rng, lr, maccum.state
                 )
                 num_update += 1
                 if use_device_metric:
-                    pending_inst += sum(
-                        int(np.prod(a.shape)) for a in batch.label)
-                    if pending_inst > 2 ** 30:
-                        eval_metric.absorb_device_state(mstate)
-                        mstate = eval_metric.device_init()
-                        pending_inst = 0
+                    maccum.after_batch(batch.label)
                 else:
                     eval_metric.update(
                         batch.label,
@@ -410,7 +426,7 @@ class FeedForward(BASE_ESTIMATOR):
                     for cb in _as_list(batch_end_callback):
                         cb(p)
             if use_device_metric:
-                eval_metric.absorb_device_state(mstate)
+                maccum.finish()
             name, value = eval_metric.get()
             logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
             logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
@@ -463,6 +479,32 @@ class FeedForward(BASE_ESTIMATOR):
                 None, self._symbol_for_bucket(bucket_key))
         return self._pred_fns[bucket_key]
 
+    def _get_eval_metric_step(self, bucket_key, eval_metric):
+        """Jitted forward + on-device metric fold for full (pad-free)
+        batches — the eval-side counterpart of the fused train metric."""
+        key = (bucket_key, eval_metric.device_key())
+        if key not in self._eval_fns:
+            graph_fn = _build_graph_fn(self._symbol_for_bucket(bucket_key),
+                                       is_train=False)
+            update = eval_metric.device_update
+            compute_dtype = self.compute_dtype
+
+            def estep(params, aux, batch, labels, mstate):
+                if compute_dtype is not None:
+                    params = {k: (v.astype(compute_dtype)
+                                  if jnp.issubdtype(v.dtype, jnp.floating)
+                                  else v) for k, v in params.items()}
+                    batch = {k: (v.astype(compute_dtype)
+                                 if jnp.issubdtype(v.dtype, jnp.floating)
+                                 else v) for k, v in batch.items()}
+                outs, _ = graph_fn({**params, **batch}, aux,
+                                   jnp.zeros((2,), jnp.uint32))
+                return update(mstate, labels,
+                              [o.astype(jnp.float32) for o in outs])
+
+            self._eval_fns[key] = jax.jit(estep, donate_argnums=(4,))
+        return self._eval_fns[key]
+
     def _eval(self, eval_iter, eval_metric, params, aux, data_names, label_names):
         # params may be mesh-sharded during fit; pull to the default device
         first = next(iter(params.values())) if params else None
@@ -470,20 +512,34 @@ class FeedForward(BASE_ESTIMATOR):
                 getattr(first.sharding, "num_devices", 1) > 1:
             params = {k: jnp.asarray(_host_local(v)) for k, v in params.items()}
             aux = {k: jnp.asarray(_host_local(v)) for k, v in aux.items()}
+        use_device_metric = eval_metric.device_supported
+        maccum = self._DeviceMetricAccum(eval_metric) if use_device_metric \
+            else None
         eval_iter.reset()
         for batch in eval_iter:
             bkey = getattr(batch, "bucket_key", None)
-            pred = self._get_pred_step(bkey)
             names = getattr(batch, "data_names", data_names)
             batch_arrays = {name: arr.data for name, arr in zip(names, batch.data)}
             batch_arrays = self._fill_missing_args(
                 params, batch_arrays, symbol=self._symbol_for_bucket(bkey))
-            outs = pred(params, aux, batch_arrays)
             pad = batch.pad
+            if use_device_metric and pad == 0:
+                # fused forward+metric, no per-batch host pull; padded tail
+                # batches (at most one per epoch) take the host path below
+                estep = self._get_eval_metric_step(bkey, eval_metric)
+                maccum.state = estep(params, aux, batch_arrays,
+                                     [l.data for l in batch.label],
+                                     maccum.state)
+                maccum.after_batch(batch.label)
+                continue
+            pred = self._get_pred_step(bkey)
+            outs = pred(params, aux, batch_arrays)
             outs = [NDArray(o[: o.shape[0] - pad] if pad else o) for o in outs]
             labels = [NDArray(l.data[: l.shape[0] - pad] if pad else l.data)
                       for l in batch.label]
             eval_metric.update(labels, outs)
+        if use_device_metric:
+            maccum.finish()
 
     # -- inference ------------------------------------------------------------
     def predict(self, X, batch_size=128):

@@ -497,6 +497,21 @@ def concatenate(arrays, axis=0):
     return NDArray(jnp.concatenate([a._data for a in arrays], axis=axis))
 
 
+def random_uniform(low=0.0, high=1.0, shape=None, out=None):
+    """Registered sampling fn (reference: _random_uniform, ndarray.cc:645;
+    the kRandom engine resource becomes an explicit PRNG key stream)."""
+    from . import random as _random
+
+    return _random.uniform(low, high, shape, out=out)
+
+
+def random_gaussian(loc=0.0, scale=1.0, shape=None, out=None):
+    """Registered sampling fn (reference: _random_gaussian, ndarray.cc:647)."""
+    from . import random as _random
+
+    return _random.normal(loc, scale, shape, out=out)
+
+
 # -- serialization (reference: NDArray::Save/Load, ndarray.cc:450-536) --------
 # Redesigned container, same layering: magic + per-tensor header + raw bytes,
 # with an optional name table for dict-style save/load.

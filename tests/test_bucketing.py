@@ -81,5 +81,5 @@ def test_bucketing_feedforward_trains_across_buckets():
     # every position is consistently predictable except the one sentence-end
     # -> pad transition per row, so well-trained accuracy lands > 0.7
     assert value > 0.7, (name, value)
-    # one compiled pred step per bucket key
-    assert set(model._pred_fns.keys()) == {4, 8}
+    # one compiled eval step per (bucket key, metric)
+    assert {k for k, _ in model._eval_fns} == {4, 8}
