@@ -229,6 +229,9 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, mean_img=None, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  scale=1.0, rand_crop=False, rand_mirror=False, resize=-1,
+                 max_rotate_angle=0, max_aspect_ratio=0.0, max_shear_ratio=0.0,
+                 min_crop_size=-1, max_crop_size=-1, random_h=0, random_s=0,
+                 random_l=0, fill_value=255,
                  num_parts=1, part_index=0, round_batch=True, seed=0,
                  preprocess_threads=None, prefetch_buffer=4, path_imglist=None,
                  **_ignored):
@@ -243,6 +246,23 @@ class ImageRecordIter(DataIter):
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self.resize = resize
+        # extended augmenter params (reference: ImageAugmentParam,
+        # image_augmenter.h — rotation, aspect/shear jitter, random-sized
+        # crop, HSL color jitter, border fill)
+        self.max_rotate_angle = max_rotate_angle
+        self.max_aspect_ratio = max_aspect_ratio
+        self.max_shear_ratio = max_shear_ratio
+        if (min_crop_size > 0) != (max_crop_size > 0) or \
+                (min_crop_size > 0 and max_crop_size < min_crop_size):
+            raise MXNetError(
+                "min_crop_size/max_crop_size must be set together with "
+                f"min <= max, got ({min_crop_size}, {max_crop_size})")
+        self.min_crop_size = min_crop_size
+        self.max_crop_size = max_crop_size
+        self.random_h = random_h
+        self.random_s = random_s
+        self.random_l = random_l
+        self.fill_value = fill_value
         self.round_batch = round_batch
         self._rng = np.random.RandomState(seed)
         self._mean = None
@@ -281,6 +301,7 @@ class ImageRecordIter(DataIter):
         self._native = None
         self._native_first = None
         use_native = (env_int("MXNET_TPU_NATIVE_IO", 1) and self._mean_is_rgb()
+                      and not self._needs_py_augment()
                       and self._records_look_jpeg())
         if use_native:
             try:
@@ -303,6 +324,13 @@ class ImageRecordIter(DataIter):
 
     def _mean_is_rgb(self):
         return self._mean is None or self._mean.size == 3
+
+    def _needs_py_augment(self):
+        """Extended augmentations only exist in the Python path; their use
+        routes around the native JPEG pipeline."""
+        return bool(self.max_rotate_angle or self.max_aspect_ratio
+                    or self.max_shear_ratio or self.random_h or self.random_s
+                    or self.random_l or self.min_crop_size > 0)
 
     def _records_look_jpeg(self, sample=16):
         """Cheap pre-check: peek the image magic of evenly-spaced records so a
@@ -359,6 +387,22 @@ class ImageRecordIter(DataIter):
                 ),
                 dtype=np.float32,
             )
+        if self.max_rotate_angle or self.max_shear_ratio:
+            from PIL import Image
+
+            pil = Image.fromarray(img.astype(np.uint8))
+            fill = tuple([int(self.fill_value)] * 3)
+            if self.max_rotate_angle:
+                angle = rng.uniform(-self.max_rotate_angle,
+                                    self.max_rotate_angle)
+                pil = pil.rotate(angle, resample=Image.BILINEAR,
+                                 fillcolor=fill)
+            if self.max_shear_ratio:
+                s = rng.uniform(-self.max_shear_ratio, self.max_shear_ratio)
+                pil = pil.transform(pil.size, Image.AFFINE,
+                                    (1, s, 0, 0, 1, 0),
+                                    resample=Image.BILINEAR, fillcolor=fill)
+            img = np.asarray(pil, dtype=np.float32)
         h, w = img.shape[:2]
         if h < target_h or w < target_w:
             from PIL import Image
@@ -368,20 +412,73 @@ class ImageRecordIter(DataIter):
                 dtype=np.float32,
             )
             h, w = img.shape[:2]
+        # random-sized / aspect-jittered crop (resized back to data_shape)
+        crop_h, crop_w = target_h, target_w
+        if self.min_crop_size > 0:
+            size = rng.randint(self.min_crop_size, self.max_crop_size + 1)
+            crop_h = crop_w = size
+        if self.max_aspect_ratio > 0:
+            ratio = 1.0 + rng.uniform(-self.max_aspect_ratio,
+                                      self.max_aspect_ratio)
+            crop_w = max(1, int(crop_w * ratio))
+        crop_h, crop_w = min(crop_h, h), min(crop_w, w)
         if self.rand_crop:
-            top = rng.randint(0, h - target_h + 1)
-            left = rng.randint(0, w - target_w + 1)
+            top = rng.randint(0, h - crop_h + 1)
+            left = rng.randint(0, w - crop_w + 1)
         else:
-            top, left = (h - target_h) // 2, (w - target_w) // 2
-        img = img[top : top + target_h, left : left + target_w]
+            top, left = (h - crop_h) // 2, (w - crop_w) // 2
+        img = img[top : top + crop_h, left : left + crop_w]
+        if (crop_h, crop_w) != (target_h, target_w):
+            from PIL import Image
+
+            img = np.asarray(
+                Image.fromarray(img.astype(np.uint8)).resize((target_w, target_h)),
+                dtype=np.float32,
+            )
         if self.rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1]
+        if self.random_h or self.random_s or self.random_l:
+            img = self._hsl_jitter(img, rng)
         img = img.transpose(2, 0, 1)  # HWC -> CHW
         if self._mean is not None:
             img = img - (self._mean if self._mean.ndim == 3 else self._mean.reshape(3, 1, 1))
         img = img * self.scale
         label = header.label if header.flag > 0 else np.float32(header.label)
         return img.astype(np.float32), label
+
+    def _hsl_jitter(self, img, rng):
+        """Random hue/saturation/lightness shifts (reference: the HSV-ish
+        color augmentation of image_augmenter.h — random_h in degrees,
+        random_s / random_l in 0-255 units, matching its parameter scale)."""
+        dh = rng.uniform(-self.random_h, self.random_h) if self.random_h else 0.0
+        ds = rng.uniform(-self.random_s, self.random_s) if self.random_s else 0.0
+        dl = rng.uniform(-self.random_l, self.random_l) if self.random_l else 0.0
+        x = np.clip(img, 0, 255) / 255.0
+        r, g, b = x[..., 0], x[..., 1], x[..., 2]
+        mx_, mn = x.max(axis=-1), x.min(axis=-1)
+        v = mx_
+        c = mx_ - mn
+        s = np.where(mx_ > 0, c / np.maximum(mx_, 1e-12), 0.0)
+        # hue in [0, 6)
+        hr = np.where(c > 0, np.mod((g - b) / np.maximum(c, 1e-12), 6.0), 0.0)
+        hg = (b - r) / np.maximum(c, 1e-12) + 2.0
+        hb = (r - g) / np.maximum(c, 1e-12) + 4.0
+        hue = np.where(mx_ == r, hr, np.where(mx_ == g, hg, hb))
+        hue = np.mod(hue + dh / 60.0, 6.0)
+        s = np.clip(s + ds / 255.0, 0.0, 1.0)
+        v = np.clip(v + dl / 255.0, 0.0, 1.0)
+        # HSV -> RGB
+        c2 = v * s
+        xm = c2 * (1 - np.abs(np.mod(hue, 2.0) - 1))
+        m = v - c2
+        z = np.zeros_like(c2)
+        idx = np.floor(hue).astype(np.int32) % 6
+        rgb = np.stack([
+            np.choose(idx, [c2, xm, z, z, xm, c2]),
+            np.choose(idx, [xm, c2, c2, xm, z, z]),
+            np.choose(idx, [z, z, xm, c2, c2, xm]),
+        ], axis=-1) + m[..., None]
+        return (rgb * 255.0).astype(np.float32)
 
     def _enqueue(self):
         """Schedule production of one batch on the host engine."""

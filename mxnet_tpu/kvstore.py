@@ -348,10 +348,11 @@ class _GroupWorkerKVStore(KVStore):
 def create(kv_type="local") -> KVStore:
     """Create a KVStore (reference: kvstore.cc:17-49 type-string factory)."""
     kv_type = kv_type.lower()
-    if kv_type in ("local", "local_update_cpu", "local_allreduce_cpu",
-                   "local_allreduce_device"):
+    if kv_type in ("local", "local_update_cpu", "local_allreduce_cpu"):
         return KVStore(kv_type)
-    if kv_type in ("device",):
+    if kv_type in ("device", "local_allreduce_device"):
+        # reference maps local_allreduce_device to the device store
+        # (kvstore.cc:17-49)
         return _DeviceKVStore(kv_type)
     if kv_type in ("dist", "dist_sync", "dist_async"):
         return _DistKVStore("dist_sync" if kv_type == "dist" else kv_type)

@@ -10,7 +10,7 @@ import numpy as np
 from .base import MXNetError, Registry
 from .ndarray import NDArray
 
-__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "MAE", "MSE", "RMSE",
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "Perplexity", "MAE", "MSE", "RMSE",
            "CrossEntropy", "CustomMetric", "CompositeEvalMetric", "create", "np_metric"]
 
 METRICS = Registry("metric")
@@ -58,9 +58,8 @@ class EvalMetric:
         cache must distinguish instances whose hyperparameters (e.g.
         CrossEntropy's eps) change the traced math."""
         hyper = tuple(sorted(
-            (k, v) for k, v in self.__dict__.items()
-            if k not in ("name", "num_inst", "sum_metric")
-            and isinstance(v, (int, float, str, bool))))
+            (k, repr(v)) for k, v in self.__dict__.items()
+            if k not in ("name", "num_inst", "sum_metric")))
         return (type(self).__name__, hyper)
 
     def reset(self):
@@ -141,9 +140,29 @@ class Accuracy(EvalMetric):
 
 @METRICS.register("top_k_accuracy")
 class TopKAccuracy(EvalMetric):
+    device_supported = True
+
     def __init__(self, top_k=5):
         self.top_k = top_k
         super().__init__(f"top_{top_k}_accuracy")
+
+    def device_init(self):
+        import jax.numpy as jnp
+
+        return (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+
+    def device_update(self, state, labels, preds):
+        import jax
+        import jax.numpy as jnp
+
+        s, n = state
+        for label, pred in zip(labels, preds[: len(labels)]):
+            label = label.astype(jnp.int32).ravel()
+            _, topk = jax.lax.top_k(pred, self.top_k)
+            s += jnp.sum(jnp.any(topk == label[:, None],
+                                 axis=1)).astype(jnp.int32)
+            n += label.shape[0]
+        return (s, n)
 
     def update(self, labels, preds):
         labels, preds = self._as_lists(labels, preds)
@@ -153,6 +172,57 @@ class TopKAccuracy(EvalMetric):
             topk = np.argsort(-pred, axis=-1)[:, : self.top_k]
             self.sum_metric += float((topk == label[:, None]).any(axis=1).sum())
             self.num_inst += label.shape[0]
+
+
+@METRICS.register("perplexity")
+class Perplexity(EvalMetric):
+    """exp of mean negative log-likelihood over (optionally masked) labels —
+    the language-model metric (capability extension; the reference era used
+    NLL printouts, later MXNet names this surface Perplexity)."""
+
+    device_supported = True
+
+    def __init__(self, ignore_label=None, eps=1e-10):
+        self.ignore_label = ignore_label
+        self.eps = eps
+        super().__init__("perplexity")
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(np.exp(self.sum_metric / self.num_inst))
+
+    def device_update(self, state, labels, preds):
+        import jax.numpy as jnp
+
+        s, n = state
+        for label, pred in zip(labels, preds[: len(labels)]):
+            lab = label.astype(jnp.int32).ravel()
+            prob = pred.astype(jnp.float32)[jnp.arange(lab.shape[0]), lab]
+            nll = -jnp.log(jnp.maximum(prob, self.eps))
+            if self.ignore_label is not None:
+                keep = (lab != self.ignore_label)
+                s += jnp.sum(jnp.where(keep, nll, 0.0))
+                n += jnp.sum(keep).astype(jnp.int32)
+            else:
+                s += jnp.sum(nll)
+                n += lab.shape[0]
+        return (s, n)
+
+    def update(self, labels, preds):
+        labels, preds = self._as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _to_numpy(label).astype(np.int64).ravel()
+            pred = _to_numpy(pred)
+            prob = pred[np.arange(label.shape[0]), label]
+            nll = -np.log(np.maximum(prob, self.eps))
+            if self.ignore_label is not None:
+                keep = label != self.ignore_label
+                self.sum_metric += float(nll[keep].sum())
+                self.num_inst += int(keep.sum())
+            else:
+                self.sum_metric += float(nll.sum())
+                self.num_inst += label.shape[0]
 
 
 @METRICS.register("mae")

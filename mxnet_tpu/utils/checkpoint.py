@@ -1,0 +1,116 @@
+"""Sharded checkpoint/resume (reference capability: SURVEY.md §5 — the
+reference's layered save/load is `NDArray::Save` + `save_checkpoint`
+(`model.py:392-462`), rank-0 writing whole arrays; the TPU equivalent is an
+Orbax-style sharded checkpoint of the param pytree + JSON'd graph, where
+every host writes only its addressable shards and restore re-shards onto
+any mesh).
+
+Two tiers:
+- `save_checkpoint`/`load_checkpoint` in `model.py` keep the reference's
+  single-file format for interchange.
+- `save_sharded`/`load_sharded` here handle distributed state: params may
+  be `jax.Array`s laid out across a mesh; restore takes an optional
+  sharding pytree so resume works on a different topology.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+__all__ = ["save_sharded", "load_sharded", "latest_step"]
+
+_STATE_DIR = "state"
+_SYMBOL_FILE = "symbol.json"
+_META_FILE = "metadata.json"
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_sharded(directory, step, params, aux=None, symbol=None,
+                 extra_meta=None):
+    """Write a sharded checkpoint for ``step`` under ``directory``.
+
+    params/aux may hold jax.Arrays sharded over a live mesh — each process
+    persists its addressable shards (orbax/tensorstore OCDBT layout), so no
+    host ever materializes the full state (the reference's rank-0
+    whole-array write cannot scale past host memory)."""
+    directory = os.path.abspath(os.fspath(directory))
+    step_dir = os.path.join(directory, str(int(step)))
+    state = {"params": dict(params)}
+    if aux:
+        state["aux"] = dict(aux)
+    _checkpointer().save(os.path.join(step_dir, _STATE_DIR), state)
+    if jax.process_index() == 0:
+        if symbol is not None:
+            symbol.save(os.path.join(step_dir, _SYMBOL_FILE))
+        meta = {"step": int(step)}
+        meta.update(extra_meta or {})
+        # metadata is written LAST: it is the completeness marker
+        # latest_step() keys on, so a crash mid-save never yields a
+        # "latest" checkpoint with missing symbol/meta
+        with open(os.path.join(step_dir, _META_FILE), "w") as f:
+            json.dump(meta, f)
+    return step_dir
+
+
+def latest_step(directory):
+    """Highest step with a complete state dir, or None."""
+    directory = os.fspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = [int(d) for d in os.listdir(directory)
+             if d.isdigit() and
+             os.path.isdir(os.path.join(directory, d, _STATE_DIR)) and
+             os.path.exists(os.path.join(directory, d, _META_FILE))]
+    return max(steps) if steps else None
+
+
+def load_sharded(directory, step=None, shardings=None):
+    """Restore ``(params, aux, symbol, meta)`` from a sharded checkpoint.
+
+    ``shardings``: optional pytree (matching {"params": ..., "aux": ...})
+    of `jax.sharding.Sharding` — arrays are restored directly into that
+    placement (possibly a different mesh than they were saved from).
+    Without it, arrays land as host numpy, matching the reference's
+    load_checkpoint behavior."""
+    directory = os.path.abspath(os.fspath(directory))
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    step_dir = os.path.join(directory, str(int(step)))
+
+    restore_args = None
+    if shardings is not None:
+        import orbax.checkpoint as ocp
+
+        restore_args = jax.tree_util.tree_map(
+            lambda s: ocp.ArrayRestoreArgs(sharding=s), shardings)
+    state = _checkpointer().restore(os.path.join(step_dir, _STATE_DIR),
+                                    restore_args=restore_args)
+    params = state.get("params", {})
+    aux = state.get("aux", {})
+    if shardings is None:
+        params = {k: np.asarray(v) for k, v in params.items()}
+        aux = {k: np.asarray(v) for k, v in aux.items()}
+
+    symbol = None
+    sym_path = os.path.join(step_dir, _SYMBOL_FILE)
+    if os.path.exists(sym_path):
+        from ..symbol import load as sym_load
+
+        symbol = sym_load(sym_path)
+    meta = {}
+    meta_path = os.path.join(step_dir, _META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    return params, aux, symbol, meta

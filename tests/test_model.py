@@ -194,3 +194,25 @@ def test_visualization():
     assert "digraph" in dot and "fc1" in dot
     summary = mx.viz.print_summary(net, shape={"data": (4, 10), "softmax_label": (4,)})
     assert "Total params" in summary
+
+
+def test_perplexity_and_topk_device_host_parity():
+    """New metrics: device_update and host update agree numerically."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    logits = rng.rand(16, 10).astype(np.float32)
+    probs = logits / logits.sum(axis=1, keepdims=True)
+    labels = rng.randint(0, 10, (16,)).astype(np.float32)
+    labels[:3] = 0  # some ignorable rows
+    for make in (lambda: mx.metric.create("perplexity"),
+                 lambda: mx.metric.Perplexity(ignore_label=0),
+                 lambda: mx.metric.create("top_k_accuracy"),):
+        host = make()
+        host.update([mx.nd.array(labels)], [mx.nd.array(probs)])
+        dev = make()
+        state = dev.device_init()
+        state = dev.device_update(state, [jnp.asarray(labels)],
+                                  [jnp.asarray(probs)])
+        dev.absorb_device_state(state)
+        np.testing.assert_allclose(dev.get()[1], host.get()[1], rtol=1e-5)

@@ -107,3 +107,50 @@ def test_image_record_iter_sharding(tmp_path):
     l0 = np.concatenate([b.label[0].asnumpy() for b in p0])
     l1 = np.concatenate([b.label[0].asnumpy() for b in p1])
     assert set(zip(l0, l0)) != set(zip(l1, l1)) or not np.allclose(l0, l1)
+
+
+def test_image_record_iter_extended_augment(tmp_path):
+    """Extended ImageAugmentParam surface (reference: image_augmenter.h):
+    rotation, shear, random-sized/aspect crops, HSL jitter — python path."""
+    path, _ = _make_imgrec(tmp_path)
+    it = mio.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 28, 28), batch_size=6,
+        rand_crop=True, rand_mirror=True, max_rotate_angle=15,
+        max_shear_ratio=0.1, min_crop_size=28, max_crop_size=34,
+        max_aspect_ratio=0.2, random_h=20, random_s=20, random_l=20,
+        seed=5)
+    # extended augments force the python pipeline
+    assert it._native is None
+    b = next(iter(it))
+    arr = b.data[0].asnumpy()
+    assert arr.shape == (6, 3, 28, 28)
+    assert np.isfinite(arr).all()
+    assert arr.min() >= 0.0 and arr.max() <= 255.0
+
+    # same seed -> identical augmented stream; different seed -> different
+    it_same = mio.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 28, 28), batch_size=6,
+        rand_crop=True, rand_mirror=True, max_rotate_angle=15,
+        max_shear_ratio=0.1, min_crop_size=28, max_crop_size=34,
+        max_aspect_ratio=0.2, random_h=20, random_s=20, random_l=20,
+        seed=5)
+    np.testing.assert_allclose(next(iter(it_same)).data[0].asnumpy(), arr)
+    it_diff = mio.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 28, 28), batch_size=6,
+        rand_crop=True, max_rotate_angle=15, seed=6)
+    assert not np.allclose(next(iter(it_diff)).data[0].asnumpy(), arr)
+
+
+def test_hsl_jitter_identity_and_bounds(tmp_path):
+    path, _ = _make_imgrec(tmp_path, n=4)
+    it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                             batch_size=2, random_h=1)
+    rng = np.random.RandomState(0)
+    img = rng.randint(0, 255, (8, 8, 3)).astype(np.float32)
+    # zero-delta jitter returns (numerically) the same image
+    it.random_h = it.random_s = it.random_l = 0
+    class _Z:
+        def uniform(self, a, b):
+            return 0.0
+    out = it._hsl_jitter(img, _Z())
+    np.testing.assert_allclose(out, img, atol=1.0)
