@@ -447,18 +447,19 @@ class ImageRecordIter(DataIter):
         return img.astype(np.float32), label
 
     def _hsl_jitter(self, img, rng):
-        """Random hue/saturation/lightness shifts (reference: the HSV-ish
-        color augmentation of image_augmenter.h — random_h in degrees,
-        random_s / random_l in 0-255 units, matching its parameter scale)."""
+        """Random hue/lightness/saturation shifts in HLS space (reference:
+        image_augmenter.h jitters the cvtColor HLS channels — random_h in
+        degrees, random_s / random_l in 0-255 units)."""
         dh = rng.uniform(-self.random_h, self.random_h) if self.random_h else 0.0
         ds = rng.uniform(-self.random_s, self.random_s) if self.random_s else 0.0
         dl = rng.uniform(-self.random_l, self.random_l) if self.random_l else 0.0
         x = np.clip(img, 0, 255) / 255.0
         r, g, b = x[..., 0], x[..., 1], x[..., 2]
         mx_, mn = x.max(axis=-1), x.min(axis=-1)
-        v = mx_
         c = mx_ - mn
-        s = np.where(mx_ > 0, c / np.maximum(mx_, 1e-12), 0.0)
+        light = (mx_ + mn) / 2.0
+        s = np.where(c > 0, c / np.maximum(1.0 - np.abs(2 * light - 1), 1e-12),
+                     0.0)
         # hue in [0, 6)
         hr = np.where(c > 0, np.mod((g - b) / np.maximum(c, 1e-12), 6.0), 0.0)
         hg = (b - r) / np.maximum(c, 1e-12) + 2.0
@@ -466,11 +467,11 @@ class ImageRecordIter(DataIter):
         hue = np.where(mx_ == r, hr, np.where(mx_ == g, hg, hb))
         hue = np.mod(hue + dh / 60.0, 6.0)
         s = np.clip(s + ds / 255.0, 0.0, 1.0)
-        v = np.clip(v + dl / 255.0, 0.0, 1.0)
-        # HSV -> RGB
-        c2 = v * s
+        light = np.clip(light + dl / 255.0, 0.0, 1.0)
+        # HLS -> RGB
+        c2 = (1.0 - np.abs(2 * light - 1)) * s
         xm = c2 * (1 - np.abs(np.mod(hue, 2.0) - 1))
-        m = v - c2
+        m = light - c2 / 2.0
         z = np.zeros_like(c2)
         idx = np.floor(hue).astype(np.int32) % 6
         rgb = np.stack([
@@ -478,7 +479,7 @@ class ImageRecordIter(DataIter):
             np.choose(idx, [xm, c2, c2, xm, z, z]),
             np.choose(idx, [z, z, xm, c2, c2, xm]),
         ], axis=-1) + m[..., None]
-        return (rgb * 255.0).astype(np.float32)
+        return (np.clip(rgb, 0.0, 1.0) * 255.0).astype(np.float32)
 
     def _enqueue(self):
         """Schedule production of one batch on the host engine."""

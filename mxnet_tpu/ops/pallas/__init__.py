@@ -14,6 +14,10 @@ On non-TPU backends every kernel runs in Pallas interpret mode, so the unit
 tests exercise the real kernel code paths on the 8-device CPU mesh.
 """
 
-from .flash_attention import flash_attention  # noqa: F401
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_with_lse,
+    flash_block_grads,
+)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse", "flash_block_grads"]

@@ -305,7 +305,67 @@ def _flash_bwd(causal, bq, bk, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
+def _blocks(q, k, block_q, block_k):
+    bq = min(block_q, max(8, q.shape[2]))
+    bk = min(block_k, max(8, k.shape[2]))
+    return bq, bk
+
+
+def flash_attention_with_lse(q, k, v, causal=False, block_q=512,
+                             block_k=1024, interpret=None):
+    """Forward flash returning ``(o, lse)`` with lse = log-sum-exp of the
+    scaled scores per query row, shape [b, h, seq].
+
+    The lse output is what makes per-shard results mergeable across a ring
+    (parallel.sequence.ring_flash_attention): softmax over a sequence split
+    into blocks recombines exactly from per-block (o, lse) pairs. Not
+    differentiable — the ring layer owns the custom VJP."""
+    if interpret is None:
+        interpret = _use_interpret()
+    b, h, sq, d = q.shape
+    bq, bk = _blocks(q, k, block_q, block_k)
+    o, res = _flash_fwd(q.reshape(b * h, sq, d),
+                        k.reshape(b * h, k.shape[2], d),
+                        v.reshape(b * h, v.shape[2], d),
+                        causal, bq, bk, interpret)
+    lse = res[4][:, :sq, 0]
+    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def flash_block_grads(q, k, v, o, lse, do, causal=False, block_q=512,
+                      block_k=1024, interpret=None):
+    """Backward of one attention block given the GLOBAL (o, lse).
+
+    This is flash attention's decomposition property: with p recomputed as
+    exp(s - lse_global), each key/value shard's (dq, dk, dv) contribution is
+    exact, so a ring backward is a sum of per-block calls. q rows beyond
+    seq pad with zeros (their do is zero, so contributions vanish)."""
+    if interpret is None:
+        interpret = _use_interpret()
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _blocks(q, k, block_q, block_k)
+    scale = 1.0 / (d ** 0.5)
+    dm = 8 if d >= 8 else d
+
+    def p3(x, axis_mult):
+        return _pad_to(_pad_to(x.reshape(b * h, x.shape[2], d), 2, dm),
+                       1, axis_mult)
+
+    qp, op, dop = p3(q, bq), p3(o, bq), p3(do, bq)
+    kp, vp = p3(k, bk), p3(v, bk)
+    # pad lse with 0: padded q rows are zero, so s=0, p=exp(0-0)=1, but
+    # do=0 there makes every gradient contribution vanish
+    lsep = _pad_to(lse.reshape(b * h, sq, 1), 1, bq)
+    dq, dk, dv = _flash_bwd_padded(qp, kp, vp, op, lsep, dop, scale=scale,
+                                   causal=causal, bq=bq, bk=bk, seq_k=sk,
+                                   interpret=interpret)
+    return (dq[:, :sq, :d].reshape(b, h, sq, d),
+            dk[:, :sk, :d].reshape(b, h, sk, d),
+            dv[:, :sk, :d].reshape(b, h, sk, d))
+
+
+def flash_attention(q, k, v, causal=False, block_q=512, block_k=1024,
                     interpret=None):
     """Blocked flash attention. q,k,v: [batch, heads, seq, head_dim].
 
@@ -317,8 +377,7 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
     if interpret is None:
         interpret = _use_interpret()
     b, h, sq, d = q.shape
-    bq = min(block_q, max(8, sq))
-    bk = min(block_k, max(8, k.shape[2]))
+    bq, bk = _blocks(q, k, block_q, block_k)
     # pad seq blocks up so bq | sq_padded handled inside _flash_fwd
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, k.shape[2], d)

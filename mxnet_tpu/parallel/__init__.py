@@ -18,7 +18,8 @@ from .mesh import make_mesh, auto_mesh, data_sharding, replicated
 from .data_parallel import shard_batch, replicate_params, allreduce_grads
 from .tensor_parallel import (column_parallel, row_parallel,
                               transformer_param_specs)
-from .sequence import ring_attention, ring_self_attention, attention_reference
+from .sequence import (ring_attention, ring_flash_attention,
+                       ring_self_attention, attention_reference)
 from .pipeline import spmd_pipeline
 from .expert import moe_ffn, init_moe_params
 
@@ -26,6 +27,7 @@ __all__ = [
     "make_mesh", "auto_mesh", "data_sharding", "replicated",
     "shard_batch", "replicate_params", "allreduce_grads",
     "column_parallel", "row_parallel", "transformer_param_specs",
-    "ring_attention", "ring_self_attention", "attention_reference",
+    "ring_attention", "ring_flash_attention", "ring_self_attention",
+    "attention_reference",
     "spmd_pipeline", "moe_ffn", "init_moe_params",
 ]

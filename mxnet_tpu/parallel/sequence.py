@@ -21,7 +21,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["ring_attention", "ring_self_attention", "attention_reference"]
+__all__ = ["ring_attention", "ring_flash_attention", "ring_self_attention",
+           "attention_reference"]
+
+_NEG = -1e30  # matches the flash kernels' large-negative mask value
 
 
 def attention_reference(q, k, v, causal=False):
@@ -87,15 +90,130 @@ def ring_attention(q, k, v, axis_name="sp", causal=False):
     return o.astype(q.dtype)
 
 
-def ring_self_attention(mesh, q, k, v, causal=False):
-    """Convenience wrapper: shard_map ring_attention over mesh axis 'sp',
-    with batch on 'dp' and heads on 'tp'."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_flash_attention(q, k, v, axis_name="sp", causal=False):
+    """Ring attention whose per-shard block math runs in the Pallas flash
+    kernels (fwd AND bwd) — the long-context fast path.
+
+    Same contract as ``ring_attention`` (call inside shard_map, q/k/v
+    sequence-sharded over ``axis_name``, equal shard sizes), but the
+    [seq/sp, seq/sp] score tile never materializes: each hop computes one
+    flash forward returning (o, lse), and shards merge by the log-sum-exp
+    recombination identity. Backward re-runs the flash backward kernel per
+    block against the GLOBAL (o, lse) and returns dk/dv to their owning
+    shard by rotating the accumulators along with the blocks."""
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal)
+    return out
+
+
+def _merge(acc, denom, m, o_i, lse_i):
+    """Fold one block's (o, lse) into the running stable combination."""
+    m_new = jnp.maximum(m, lse_i)
+    w_prev = jnp.exp(m - m_new)
+    w_i = jnp.exp(lse_i - m_new)
+    acc = acc * w_prev[..., None] + o_i.astype(jnp.float32) * w_i[..., None]
+    denom = denom * w_prev + w_i
+    return acc, denom, m_new
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal):
+    from ..ops.pallas import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    if k.shape[2] != sq:
+        raise ValueError("ring_flash_attention needs equal q/kv shard sizes")
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    acc = jnp.zeros((b, h, sq, d), jnp.float32)
+    denom = jnp.zeros((b, h, sq), jnp.float32)
+    m = jnp.full((b, h, sq), _NEG, jnp.float32)
+    k_blk, v_blk = k, v
+    for i in range(n):  # static ring size: unrolled, hops overlap compute
+        src = (my - i) % n
+        if i == 0:
+            o_i, lse_i = flash_attention_with_lse(q, k_blk, v_blk,
+                                                  causal=causal)
+        elif causal:
+            # whole block allowed iff it holds strictly-earlier positions
+            o_i, lse_i = lax.cond(
+                src < my,
+                lambda args: flash_attention_with_lse(*args, causal=False),
+                lambda args: (jnp.zeros((b, h, sq, d), args[0].dtype),
+                              jnp.full((b, h, sq), _NEG, jnp.float32)),
+                (q, k_blk, v_blk))
+        else:
+            o_i, lse_i = flash_attention_with_lse(q, k_blk, v_blk,
+                                                  causal=False)
+        acc, denom, m = _merge(acc, denom, m, o_i, lse_i)
+        if i != n - 1:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+    out = (acc / jnp.maximum(denom[..., None], 1e-30)).astype(q.dtype)
+    lse_global = m + jnp.log(jnp.maximum(denom, 1e-30))
+    return out, (q, k, v, out, lse_global)
+
+
+def _ring_flash_bwd(axis_name, causal, res, g):
+    from ..ops.pallas import flash_block_grads
+
+    q, k, v, out, lse_global = res
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    dq = jnp.zeros((b, h, sq, d), jnp.float32)
+    dk_acc = jnp.zeros((b, h, sq, d), jnp.float32)
+    dv_acc = jnp.zeros((b, h, sq, d), jnp.float32)
+    k_blk, v_blk = k, v
+    for i in range(n):
+        src = (my - i) % n
+        if i == 0:
+            grads = flash_block_grads(q, k_blk, v_blk, out, lse_global, g,
+                                      causal=causal)
+        elif causal:
+            grads = lax.cond(
+                src < my,
+                lambda args: flash_block_grads(*args, causal=False),
+                lambda args: (jnp.zeros_like(args[0]),
+                              jnp.zeros_like(args[1]),
+                              jnp.zeros_like(args[2])),
+                (q, k_blk, v_blk, out, lse_global, g))
+        else:
+            grads = flash_block_grads(q, k_blk, v_blk, out, lse_global, g,
+                                      causal=False)
+        dq_i, dk_i, dv_i = grads
+        dq = dq + dq_i.astype(jnp.float32)
+        dk_acc = dk_acc + dk_i.astype(jnp.float32)
+        dv_acc = dv_acc + dv_i.astype(jnp.float32)
+        # dk/dv accumulators travel WITH their kv block; after n rotations
+        # each block's gradient sum lands back on its owning shard
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_self_attention(mesh, q, k, v, causal=False, use_flash=False):
+    """Convenience wrapper: shard_map ring attention over mesh axis 'sp',
+    with batch on 'dp' and heads on 'tp'. ``use_flash`` routes the per-block
+    math through the Pallas flash kernels (ring_flash_attention)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P("dp", "tp", "sp", None)
-    fn = shard_map(
-        functools.partial(ring_attention, axis_name="sp", causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
-    )
+    if use_flash:
+        def body(q, k, v):
+            return ring_flash_attention(q, k, v, "sp", causal)
+    else:
+        body = functools.partial(ring_attention, axis_name="sp",
+                                 causal=causal)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
     return fn(q, k, v)

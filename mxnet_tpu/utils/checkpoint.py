@@ -44,6 +44,17 @@ def save_sharded(directory, step, params, aux=None, symbol=None,
     whole-array write cannot scale past host memory)."""
     directory = os.path.abspath(os.fspath(directory))
     step_dir = os.path.join(directory, str(int(step)))
+    if os.path.exists(step_dir):
+        # overwrite semantics like the reference's save_checkpoint — also
+        # clears partial state from a crash mid-save so the step can retry
+        if jax.process_index() == 0:
+            import shutil
+
+            shutil.rmtree(step_dir)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("mxtpu_ckpt_rm")
     state = {"params": dict(params)}
     if aux:
         state["aux"] = dict(aux)
