@@ -154,7 +154,9 @@ class TransformerLM:
             k = cst(k, P("dp", "tp", "sp", None))
             v = cst(v, P("dp", "tp", "sp", None))
             if use_sp:
-                attn = ring_self_attention(mesh, q, k, v, causal=True)
+                # flash blocks inside the ring on TPU; dense blocks in tests
+                attn = ring_self_attention(mesh, q, k, v, causal=True,
+                                           use_flash=self._use_flash())
             elif self._use_flash():
                 from ..ops.pallas import flash_attention
                 if mesh is None or q.shape[0] % mesh.shape.get("dp", 1) or \

@@ -189,9 +189,11 @@ def _ring_flash_bwd(axis_name, causal, res, g):
         dk_acc = dk_acc + dk_i.astype(jnp.float32)
         dv_acc = dv_acc + dv_i.astype(jnp.float32)
         # dk/dv accumulators travel WITH their kv block; after n rotations
-        # each block's gradient sum lands back on its owning shard
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        # each block's gradient sum lands back on its owning shard. The kv
+        # blocks themselves are dead after the last step — don't ship them.
+        if i != n - 1:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
         dk_acc = lax.ppermute(dk_acc, axis_name, perm)
         dv_acc = lax.ppermute(dv_acc, axis_name, perm)
     return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)

@@ -44,17 +44,18 @@ def save_sharded(directory, step, params, aux=None, symbol=None,
     whole-array write cannot scale past host memory)."""
     directory = os.path.abspath(os.fspath(directory))
     step_dir = os.path.join(directory, str(int(step)))
-    if os.path.exists(step_dir):
-        # overwrite semantics like the reference's save_checkpoint — also
-        # clears partial state from a crash mid-save so the step can retry
-        if jax.process_index() == 0:
-            import shutil
+    # overwrite semantics like the reference's save_checkpoint — also clears
+    # partial state from a crash mid-save so the step can retry. The barrier
+    # runs unconditionally (not behind the exists check) so every process
+    # enters the collective regardless of what its local filesystem shows.
+    if jax.process_index() == 0 and os.path.exists(step_dir):
+        import shutil
 
-            shutil.rmtree(step_dir)
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+        shutil.rmtree(step_dir)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("mxtpu_ckpt_rm")
+        multihost_utils.sync_global_devices("mxtpu_ckpt_rm")
     state = {"params": dict(params)}
     if aux:
         state["aux"] = dict(aux)
