@@ -319,13 +319,40 @@ class FeedForward(BASE_ESTIMATOR):
     # -- fit ------------------------------------------------------------------
     def fit(self, X, y=None, eval_data=None, eval_metric="accuracy",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
-            logger=None, work_load_list=None, batch_size=128):
+            logger=None, work_load_list=None, batch_size=128,
+            sharded_checkpoint_dir=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
         shards the batch evenly (heterogeneous device splits don't exist on a
-        TPU slice)."""
+        TPU slice).
+
+        ``sharded_checkpoint_dir``: when set, the LIVE device state (params
+        may be mesh-sharded) is checkpointed per epoch via
+        utils.checkpoint.save_sharded, and training auto-resumes from the
+        newest complete step in that directory (SURVEY.md §5's TPU-native
+        checkpoint/resume: every host writes only its shards)."""
         del work_load_list
+        resume_opt_leaves, resume_num_update = None, 0
+        if sharded_checkpoint_dir is not None:
+            from .utils import checkpoint as ckpt_mod
+
+            last = ckpt_mod.latest_step(sharded_checkpoint_dir)
+            if last is not None:
+                # FeedForward keeps params replicated (dp training), so the
+                # host-numpy restore is the right cost here; mesh-sharded
+                # restore stays available via utils.checkpoint directly.
+                loaded, laux, _, meta, resume_opt_leaves = \
+                    ckpt_mod.load_sharded(sharded_checkpoint_dir, last)
+                self.arg_params = {k: NDArray(np.asarray(v))
+                                   for k, v in loaded.items()}
+                self.aux_params = {k: NDArray(np.asarray(v))
+                                   for k, v in laux.items()}
+                self.begin_epoch = int(meta.get("epoch", last))
+                resume_num_update = int(meta.get("num_update", 0))
+                (logger or logging).info(
+                    "resumed sharded checkpoint step %d (epoch %d)",
+                    last, self.begin_epoch)
         if logger is None:
             logger = logging
         train_data = _init_iter(X, y, batch_size, shuffle=True)
@@ -371,6 +398,13 @@ class FeedForward(BASE_ESTIMATOR):
         params = {k: jnp.asarray(self.arg_params[k].asnumpy()) for k in param_names}
         aux = {k: jnp.asarray(self.aux_params[k].asnumpy()) for k in aux_names}
         opt_state = optimizer.init_state_tree(params)
+        if resume_opt_leaves is not None:
+            # restore momentum/moments: re-thread the saved flat leaves
+            # through this optimizer's state structure
+            flat, treedef = jax.tree_util.tree_flatten(opt_state)
+            if len(flat) == len(resume_opt_leaves):
+                opt_state = jax.tree_util.tree_unflatten(
+                    treedef, [jnp.asarray(leaf) for leaf in resume_opt_leaves])
         # One compiled step per bucket key (None = the single-symbol case);
         # all entries share the same live param/opt-state pytrees.
         train_steps = {}
@@ -384,7 +418,7 @@ class FeedForward(BASE_ESTIMATOR):
         use_device_metric = (eval_metric.device_supported
                              and batch_end_callback is None)
         metric_update = eval_metric.device_update if use_device_metric else None
-        num_update = 0
+        num_update = resume_num_update
         for epoch in range(self.begin_epoch, self.num_epoch or 1):
             tic = time.time()
             eval_metric.reset()
@@ -430,6 +464,15 @@ class FeedForward(BASE_ESTIMATOR):
             name, value = eval_metric.get()
             logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
             logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+
+            if sharded_checkpoint_dir is not None:
+                from .utils import checkpoint as ckpt_mod
+
+                ckpt_mod.save_sharded(
+                    sharded_checkpoint_dir, epoch + 1, params, aux=aux,
+                    symbol=self.symbol, opt_state=opt_state,
+                    extra_meta={"epoch": epoch + 1,
+                                "num_update": num_update})
 
             # write state back so callbacks/checkpoints see current values
             # (device_get: sharded -> host, so predict/save work off-mesh)

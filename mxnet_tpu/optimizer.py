@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from .base import MXNetError, Registry
 from .ndarray import NDArray, zeros
 
-__all__ = ["Optimizer", "SGD", "Adam", "RMSProp", "AdaGrad", "create", "get_updater"]
+__all__ = ["Optimizer", "SGD", "Test", "Adam", "RMSProp", "AdaGrad", "create", "get_updater"]
 
 OPTIMIZERS = Registry("optimizer")
 
@@ -112,6 +112,27 @@ class SGD(Optimizer):
             state._set_data(mom)
             return new_w, state
         return new_w, mom
+
+
+@OPTIMIZERS.register("test")
+class Test(Optimizer):
+    """Test-only optimizer (reference: optimizer.py:162 Test) —
+    w += rescale_grad * grad, state mirrors the weight."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def tree_state(self, w):
+        return jnp.zeros(w.shape, jnp.float32)
+
+    def _apply_one(self, w, g, state, lr):
+        del lr
+        new_w = (w.astype(jnp.float32)
+                 + g.astype(jnp.float32) * self.rescale_grad).astype(w.dtype)
+        if isinstance(state, NDArray):
+            state._set_data(new_w.astype(jnp.float32))
+            return new_w, state
+        return new_w, new_w.astype(jnp.float32)
 
 
 @OPTIMIZERS.register("adam")

@@ -35,7 +35,7 @@ def _checkpointer():
 
 
 def save_sharded(directory, step, params, aux=None, symbol=None,
-                 extra_meta=None):
+                 extra_meta=None, opt_state=None):
     """Write a sharded checkpoint for ``step`` under ``directory``.
 
     params/aux may hold jax.Arrays sharded over a live mesh — each process
@@ -59,6 +59,10 @@ def save_sharded(directory, step, params, aux=None, symbol=None,
     state = {"params": dict(params)}
     if aux:
         state["aux"] = dict(aux)
+    if opt_state is not None:
+        # stored as flat leaves: orbax turns tuples into lists on restore,
+        # so the caller re-threads them through its own treedef
+        state["opt"] = list(jax.tree_util.tree_leaves(opt_state))
     _checkpointer().save(os.path.join(step_dir, _STATE_DIR), state)
     if jax.process_index() == 0:
         if symbol is not None:
@@ -86,7 +90,9 @@ def latest_step(directory):
 
 
 def load_sharded(directory, step=None, shardings=None):
-    """Restore ``(params, aux, symbol, meta)`` from a sharded checkpoint.
+    """Restore ``(params, aux, symbol, meta, opt_leaves)`` from a sharded
+    checkpoint. ``opt_leaves`` is the flat optimizer-state leaf list (or
+    None) — re-thread it through your optimizer's treedef.
 
     ``shardings``: optional pytree (matching {"params": ..., "aux": ...})
     of `jax.sharding.Sharding` — arrays are restored directly into that
@@ -110,6 +116,7 @@ def load_sharded(directory, step=None, shardings=None):
                                     restore_args=restore_args)
     params = state.get("params", {})
     aux = state.get("aux", {})
+    opt_leaves = state.get("opt")
     if shardings is None:
         params = {k: np.asarray(v) for k, v in params.items()}
         aux = {k: np.asarray(v) for k, v in aux.items()}
@@ -125,4 +132,4 @@ def load_sharded(directory, step=None, shardings=None):
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
-    return params, aux, symbol, meta
+    return params, aux, symbol, meta, opt_leaves

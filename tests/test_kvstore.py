@@ -164,3 +164,18 @@ def test_dist_single_process():
     kv.pull(1, out=out)
     _same(out.asnumpy(), np.ones(SHAPE) * 3)
     kv.barrier()
+
+
+def test_test_optimizer_updater_semantics():
+    """reference optimizer.py:162 Test: w += rescale_grad * grad; the state
+    mirrors the updated weight (used by kvstore updater tests)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    opt = mx.optimizer.create("test", rescale_grad=0.5)
+    updater = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(np.ones(4, np.float32))
+    g = mx.nd.array(np.full(4, 2.0, np.float32))
+    updater(0, g, w)
+    np.testing.assert_allclose(w.asnumpy(), 2.0)

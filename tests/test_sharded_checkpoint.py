@@ -31,7 +31,7 @@ def test_save_load_roundtrip_host(tmp_path):
     save_sharded(tmp_path, 3, params, aux={"m": jnp.ones((2,))}, symbol=sym,
                  extra_meta={"epoch": 7})
     assert latest_step(tmp_path) == 3
-    loaded, aux, symbol, meta = load_sharded(tmp_path)
+    loaded, aux, symbol, meta, _ = load_sharded(tmp_path)
     assert meta["epoch"] == 7
     assert symbol.list_arguments() == sym.list_arguments()
     np.testing.assert_allclose(loaded["fc1_weight"],
@@ -49,7 +49,7 @@ def test_restore_onto_mesh(tmp_path):
         "fc1_weight": NamedSharding(mesh2, P("tp", None)),
         "fc1_bias": NamedSharding(mesh2, P()),
     }}
-    loaded, _, _, _ = load_sharded(tmp_path, shardings=shardings)
+    loaded, _, _, _, _ = load_sharded(tmp_path, shardings=shardings)
     w = loaded["fc1_weight"]
     assert isinstance(w, jax.Array)
     assert w.sharding.spec == P("tp", None)
@@ -62,6 +62,33 @@ def test_multiple_steps_and_latest(tmp_path):
     for step in (1, 5, 10):
         save_sharded(tmp_path, step, params)
     assert latest_step(tmp_path) == 10
-    p5, _, _, _ = load_sharded(tmp_path, step=5)
+    p5, _, _, _, _ = load_sharded(tmp_path, step=5)
     np.testing.assert_allclose(p5["fc1_bias"],
                                np.asarray(params["fc1_bias"]))
+
+
+def test_fit_sharded_checkpoint_and_resume(tmp_path):
+    """fit(sharded_checkpoint_dir=...) writes per-epoch sharded state and a
+    fresh fit() on the same dir resumes from the newest step."""
+    from mxnet_tpu.models import mlp
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 4).astype(np.float32)
+    d = str(tmp_path / "ckpt")
+
+    m1 = mx.FeedForward(mlp(num_classes=2, hidden=(16,)), num_epoch=2,
+                        optimizer="sgd", learning_rate=0.1,
+                        initializer=mx.init.Xavier())
+    m1.fit(X, y, batch_size=16, sharded_checkpoint_dir=d)
+    assert latest_step(d) == 2
+
+    m2 = mx.FeedForward(mlp(num_classes=2, hidden=(16,)), num_epoch=4,
+                        optimizer="sgd", learning_rate=0.1,
+                        initializer=mx.init.Xavier())
+    m2.fit(X, y, batch_size=16, sharded_checkpoint_dir=d)
+    # resumed at epoch 2, trained to 4, checkpoints advanced
+    assert m2.begin_epoch == 2
+    assert latest_step(d) == 4
+    _, _, symbol, meta, _ = load_sharded(d, step=2)
+    assert meta["epoch"] == 2 and symbol is not None
