@@ -12,10 +12,12 @@ so vs_baseline = value / 97.0 — "how much faster than the reference's best
 same-class single-device training throughput".
 
 Method: fused train step (forward + backward + SGD-momentum update in one
-donated XLA program), bf16 compute / f32 master params, synthetic on-device
-data (the input pipeline is benchmarked separately; the reference's numbers
-are likewise decode-bound only beyond 3000 img/s, README:5). Warmup 2 steps
-(compile), then timed steps with a hard device sync at the end.
+donated XLA program), NHWC activations (channels on the MXU lane dimension;
+weights stay OIHW for checkpoint parity), bf16 compute / f32 master params,
+one-pass-statistics BatchNorm, synthetic on-device data (the input pipeline
+is benchmarked separately; the reference's numbers are likewise decode-bound
+only beyond 3000 img/s, README:5). Warmup 2 steps (compile), then timed
+steps with a hard device sync at the end.
 """
 
 from __future__ import annotations
@@ -28,15 +30,20 @@ import time
 import numpy as np
 
 
-def build_resnet50_train_step(batch_size, lr=0.1, momentum=0.9):
+def _data_shape(batch_size, layout):
+    return (batch_size, 224, 224, 3) if layout == "NHWC" else \
+        (batch_size, 3, 224, 224)
+
+
+def build_resnet50_train_step(batch_size, lr=0.1, momentum=0.9, layout="NHWC"):
     import jax
     import jax.numpy as jnp
 
     from mxnet_tpu.executor import _build_graph_fn
     from mxnet_tpu.models import resnet50
 
-    sym = resnet50(num_classes=1000)
-    input_shapes = {"data": (batch_size, 3, 224, 224),
+    sym = resnet50(num_classes=1000, layout=layout)
+    input_shapes = {"data": _data_shape(batch_size, layout),
                     "softmax_label": (batch_size,)}
     arg_shapes, _, aux_shapes = sym.infer_shape(**input_shapes)
     arg_names = sym.list_arguments()
@@ -83,9 +90,10 @@ def build_resnet50_train_step(batch_size, lr=0.1, momentum=0.9):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=256)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--layout", choices=("NCHW", "NHWC"), default="NHWC")
     args = ap.parse_args()
 
     import jax
@@ -93,9 +101,11 @@ def main():
     dev = jax.devices()[0]
     print(f"bench device: {dev}", file=sys.stderr)
 
-    step, params, moms, aux = build_resnet50_train_step(args.batch_size)
+    step, params, moms, aux = build_resnet50_train_step(
+        args.batch_size, layout=args.layout)
     rng = np.random.RandomState(0)
-    data = jax.device_put(rng.randn(args.batch_size, 3, 224, 224).astype(np.float32))
+    data = jax.device_put(
+        rng.randn(*_data_shape(args.batch_size, args.layout)).astype(np.float32))
     label = jax.device_put(
         rng.randint(0, 1000, (args.batch_size,)).astype(np.float32))
 

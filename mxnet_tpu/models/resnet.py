@@ -1,53 +1,66 @@
 """ResNet (v1.5 bottleneck) — the north-star benchmark model
 (BASELINE.json: ResNet-50 ImageNet images/sec/chip on v5e). The reference
 predates ResNet; this is the modern flagship the rebuild targets, built from
-the same Symbol ops."""
+the same Symbol ops.
+
+``layout``: "NCHW" keeps reference parity; "NHWC" is the TPU fast path
+(channels on the MXU lane dimension — no relayout transposes in the HLO).
+Weights are OIHW either way, so checkpoints are layout-portable.
+"""
 
 from .. import symbol as sym
 
 
 def _conv_bn(data, num_filter, kernel, stride=(1, 1), pad=(0, 0), name=None,
-             act=True):
+             act=True, layout="NCHW"):
     conv = sym.Convolution(data=data, name=f"{name}_conv", kernel=kernel,
                            stride=stride, pad=pad, num_filter=num_filter,
-                           no_bias=True)
-    bn = sym.BatchNorm(data=conv, name=f"{name}_bn", eps=1e-5, momentum=0.9)
+                           no_bias=True, layout=layout)
+    bn_axis = 3 if layout == "NHWC" else 1
+    bn = sym.BatchNorm(data=conv, name=f"{name}_bn", eps=1e-5, momentum=0.9,
+                       axis=bn_axis)
     if act:
         return sym.Activation(data=bn, name=f"{name}_relu", act_type="relu")
     return bn
 
 
-def _bottleneck(data, num_filter, stride, dim_match, name):
-    c1 = _conv_bn(data, num_filter // 4, (1, 1), name=f"{name}_br1")
+def _bottleneck(data, num_filter, stride, dim_match, name, layout="NCHW"):
+    c1 = _conv_bn(data, num_filter // 4, (1, 1), name=f"{name}_br1",
+                  layout=layout)
     c2 = _conv_bn(c1, num_filter // 4, (3, 3), stride=stride, pad=(1, 1),
-                  name=f"{name}_br2")
-    c3 = _conv_bn(c2, num_filter, (1, 1), name=f"{name}_br3", act=False)
+                  name=f"{name}_br2", layout=layout)
+    c3 = _conv_bn(c2, num_filter, (1, 1), name=f"{name}_br3", act=False,
+                  layout=layout)
     if dim_match:
         shortcut = data
     else:
         shortcut = _conv_bn(data, num_filter, (1, 1), stride=stride,
-                            name=f"{name}_sc", act=False)
+                            name=f"{name}_sc", act=False, layout=layout)
     total = c3 + shortcut
     return sym.Activation(data=total, name=f"{name}_out", act_type="relu")
 
 
-def resnet(units, num_classes=1000, filter_list=(256, 512, 1024, 2048)):
+def resnet(units, num_classes=1000, filter_list=(256, 512, 1024, 2048),
+           layout="NCHW"):
     data = sym.Variable("data")
-    body = _conv_bn(data, 64, (7, 7), stride=(2, 2), pad=(3, 3), name="stem")
+    body = _conv_bn(data, 64, (7, 7), stride=(2, 2), pad=(3, 3), name="stem",
+                    layout=layout)
     body = sym.Pooling(data=body, name="stem_pool", kernel=(3, 3),
-                       stride=(2, 2), pad=(1, 1), pool_type="max")
+                       stride=(2, 2), pad=(1, 1), pool_type="max",
+                       layout=layout)
     for i, (n_unit, nf) in enumerate(zip(units, filter_list)):
         stride = (1, 1) if i == 0 else (2, 2)
-        body = _bottleneck(body, nf, stride, False, name=f"stage{i + 1}_unit1")
+        body = _bottleneck(body, nf, stride, False, name=f"stage{i + 1}_unit1",
+                           layout=layout)
         for j in range(1, n_unit):
             body = _bottleneck(body, nf, (1, 1), True,
-                               name=f"stage{i + 1}_unit{j + 1}")
+                               name=f"stage{i + 1}_unit{j + 1}", layout=layout)
     pool = sym.Pooling(data=body, name="global_pool", kernel=(7, 7),
-                       pool_type="avg", global_pool=True)
+                       pool_type="avg", global_pool=True, layout=layout)
     flat = sym.Flatten(data=pool, name="flatten")
     fc = sym.FullyConnected(data=flat, name="fc1", num_hidden=num_classes)
     return sym.SoftmaxOutput(data=fc, name="softmax")
 
 
-def resnet50(num_classes=1000):
-    return resnet((3, 4, 6, 3), num_classes)
+def resnet50(num_classes=1000, layout="NCHW"):
+    return resnet((3, 4, 6, 3), num_classes, layout=layout)

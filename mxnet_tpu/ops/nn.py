@@ -5,10 +5,14 @@ convolution-inl.h, deconvolution-inl.h, pooling-inl.h, batch_norm-inl.h,
 dropout-inl.h, lrn-inl.h, activation-inl.h, leaky_relu-inl.h.
 
 TPU-native design notes:
-  - Convolution lowers to ``lax.conv_general_dilated`` in NCHW/OIHW — XLA:TPU
-    retiles this onto the MXU; the reference's im2col + grouped GEMM +
-    workspace chunking (convolution-inl.h:68-140) is exactly what the compiler
-    does better, so none of it is reimplemented.
+  - Convolution lowers to ``lax.conv_general_dilated``; the reference's
+    im2col + grouped GEMM + workspace chunking (convolution-inl.h:68-140) is
+    exactly what the compiler does better, so none of it is reimplemented.
+  - Conv/Pooling take a ``layout`` param (NCHW default for reference parity;
+    NHWC is the fast path on TPU — channels land on the lane dimension of the
+    MXU/VPU so XLA needs no relayout transposes). Weights stay OIHW in both
+    layouts so checkpoints map 1:1. BatchNorm takes ``axis`` for the channel
+    dimension (1 for NCHW activations, -1 for NHWC).
   - Pooling is ``lax.reduce_window``; LRN is a windowed mean over channels.
   - BatchNorm carries aux state (moving_mean/moving_var, batch_norm-inl.h:88)
     functionally: fwd returns updated aux, the executor writes it back.
@@ -72,7 +76,8 @@ class FullyConnectedOp(OpProp):
 
 @register_op("Convolution")
 class ConvolutionOp(OpProp):
-    """2-D convolution, NCHW/OIHW (reference: convolution-inl.h)."""
+    """2-D convolution (reference: convolution-inl.h). Weights are OIHW in
+    both layouts; ``layout`` only changes the activation layout."""
 
     params = {
         "kernel": (TupleParam(2), REQUIRED, "kernel (h, w)"),
@@ -83,6 +88,7 @@ class ConvolutionOp(OpProp):
         "num_group": (int, 1, "grouped-convolution group count"),
         "no_bias": (bool, False, "omit the bias term"),
         "workspace": (int, 512, "accepted for parity; XLA manages scratch"),
+        "layout": (("NCHW", "NHWC"), "NCHW", "activation layout (NHWC = TPU fast path)"),
     }
 
     def list_arguments(self):
@@ -99,14 +105,19 @@ class ConvolutionOp(OpProp):
     def infer_shape(self, in_shapes):
         d = self._known(in_shapes, 0)
         if len(d) != 4:
-            raise MXNetError(f"Convolution expects NCHW input, got {d}")
-        n, c, h, w = d
+            raise MXNetError(f"Convolution expects 4-D input, got {d}")
+        if self.layout == "NHWC":
+            n, h, w, c = d
+        else:
+            n, c, h, w = d
         if c % self.num_group or self.num_filter % self.num_group:
             raise MXNetError("Convolution: channels not divisible by num_group")
         wshape = (self.num_filter, c // self.num_group) + self.kernel
         oh, ow = self._out_hw(h, w)
+        out = (n, oh, ow, self.num_filter) if self.layout == "NHWC" else \
+            (n, self.num_filter, oh, ow)
         shapes = [d, wshape] + ([] if self.no_bias else [(self.num_filter,)])
-        return shapes, [(n, self.num_filter, oh, ow)], []
+        return shapes, [out], []
 
     def fwd(self, ins, aux, is_train, rng):
         x = ins[0]
@@ -119,11 +130,12 @@ class ConvolutionOp(OpProp):
             window_strides=self.stride,
             padding=[(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])],
             rhs_dilation=self.dilate,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=(self.layout, "OIHW", self.layout),
             feature_group_count=self.num_group,
         )
         if not self.no_bias:
-            y = y + ins[2].astype(x.dtype).reshape((1, -1, 1, 1))
+            bshape = (1, 1, 1, -1) if self.layout == "NHWC" else (1, -1, 1, 1)
+            y = y + ins[2].astype(x.dtype).reshape(bshape)
         return [y], []
 
 
@@ -141,6 +153,7 @@ class DeconvolutionOp(OpProp):
         "num_group": (int, 1, "group count"),
         "no_bias": (bool, True, "omit the bias term"),
         "workspace": (int, 512, "accepted for parity"),
+        "layout": (("NCHW", "NHWC"), "NCHW", "activation layout (NHWC = TPU fast path)"),
     }
 
     def list_arguments(self):
@@ -148,15 +161,20 @@ class DeconvolutionOp(OpProp):
 
     def infer_shape(self, in_shapes):
         d = self._known(in_shapes, 0)
-        n, c, h, w = d
+        if self.layout == "NHWC":
+            n, h, w, c = d
+        else:
+            n, c, h, w = d
         kh, kw = self.kernel
         sh, sw = self.stride
         ph, pw = self.pad
         oh = sh * (h - 1) + kh - 2 * ph
         ow = sw * (w - 1) + kw - 2 * pw
         wshape = (c, self.num_filter // self.num_group) + self.kernel
+        out = (n, oh, ow, self.num_filter) if self.layout == "NHWC" else \
+            (n, self.num_filter, oh, ow)
         shapes = [d, wshape] + ([] if self.no_bias else [(self.num_filter,)])
-        return shapes, [(n, self.num_filter, oh, ow)], []
+        return shapes, [out], []
 
     def fwd(self, ins, aux, is_train, rng):
         x = ins[0]
@@ -179,17 +197,19 @@ class DeconvolutionOp(OpProp):
             window_strides=(1, 1),
             padding=[(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)],
             lhs_dilation=self.stride,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=(self.layout, "OIHW", self.layout),
             feature_group_count=self.num_group,
         )
         if not self.no_bias:
-            y = y + ins[2].astype(x.dtype).reshape((1, -1, 1, 1))
+            bshape = (1, 1, 1, -1) if self.layout == "NHWC" else (1, -1, 1, 1)
+            y = y + ins[2].astype(x.dtype).reshape(bshape)
         return [y], []
 
 
 @register_op("Pooling")
 class PoolingOp(OpProp):
-    """Max/avg/sum pooling over NCHW (reference: pooling-inl.h).
+    """Max/avg/sum pooling, NCHW or NHWC per ``layout`` (reference:
+    pooling-inl.h).
 
     Matches the reference's ceil-mode output arithmetic
     ((x + 2p - k) / s + 1 rounded up when it doesn't divide; mshadow pool uses
@@ -201,6 +221,7 @@ class PoolingOp(OpProp):
         "pad": (TupleParam(2), (0, 0), "padding (h, w)"),
         "pool_type": (("max", "avg", "sum"), "max", "pooling reduction"),
         "global_pool": (bool, False, "pool over the full spatial extent"),
+        "layout": (("NCHW", "NHWC"), "NCHW", "activation layout (NHWC = TPU fast path)"),
     }
 
     def _dims(self, h, w):
@@ -211,25 +232,43 @@ class PoolingOp(OpProp):
         ph, pw = self.pad
         return (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
 
+    def _spatial(self):
+        return (1, 2) if self.layout == "NHWC" else (2, 3)
+
     def infer_shape(self, in_shapes):
-        n, c, h, w = self._known(in_shapes, 0)
-        oh, ow = self._dims(h, w)
-        return [(n, c, h, w)], [(n, c, oh, ow)], []
+        d = self._known(in_shapes, 0)
+        sh, sw = self._spatial()
+        oh, ow = self._dims(d[sh], d[sw])
+        out = list(d)
+        out[sh], out[sw] = oh, ow
+        return [d], [tuple(out)], []
 
     def fwd(self, ins, aux, is_train, rng):
         x = ins[0]
+        sdims = self._spatial()
         if self.global_pool:
-            kernel, stride, pad = (x.shape[2], x.shape[3]), (1, 1), (0, 0)
-        else:
-            kernel, stride, pad = self.kernel, self.stride, self.pad
-        window = (1, 1) + tuple(kernel)
-        strides = (1, 1) + tuple(stride)
-        padding = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+            # full-extent reduce: a plain reduction fuses better than a
+            # degenerate reduce_window
+            if self.pool_type == "max":
+                y = jnp.max(x, axis=sdims, keepdims=True)  # native dtype: exact
+            else:
+                y = jnp.sum(x.astype(jnp.float32), axis=sdims, keepdims=True)
+                if self.pool_type == "avg":
+                    y = y / (x.shape[sdims[0]] * x.shape[sdims[1]])
+            return [y.astype(x.dtype)], []
+        kernel, stride, pad = self.kernel, self.stride, self.pad
+        window = [1, 1, 1, 1]
+        strides = [1, 1, 1, 1]
+        padding = [(0, 0), (0, 0), (0, 0), (0, 0)]
+        for i, d in enumerate(sdims):
+            window[d] = kernel[i]
+            strides[d] = stride[i]
+            padding[d] = (pad[i], pad[i])
         if self.pool_type == "max":
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-            y = lax.reduce_window(x, init, lax.max, window, strides, padding)
+            y = lax.reduce_window(x, init, lax.max, tuple(window), tuple(strides), tuple(padding))
         else:
-            y = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            y = lax.reduce_window(x, 0.0, lax.add, tuple(window), tuple(strides), tuple(padding))
             if self.pool_type == "avg":
                 y = y / (kernel[0] * kernel[1])
         return [y.astype(x.dtype)], []
@@ -329,6 +368,7 @@ class BatchNormOp(OpProp):
         "eps": (float, 1e-3, "numerical stability constant"),
         "momentum": (float, 0.9, "running-average decay"),
         "fix_gamma": (bool, False, "freeze gamma at 1"),
+        "axis": (int, 1, "channel axis (1 for NCHW, -1/3 for NHWC)"),
     }
 
     def list_arguments(self):
@@ -338,7 +378,9 @@ class BatchNormOp(OpProp):
         return ["moving_mean", "moving_var"]
 
     def _channels(self, d):
-        return d[1] if len(d) >= 2 else d[0]
+        if len(d) < 2:
+            return d[0]
+        return d[self.axis % len(d)]
 
     def infer_shape(self, in_shapes):
         d = self._known(in_shapes, 0)
@@ -348,14 +390,26 @@ class BatchNormOp(OpProp):
     def fwd(self, ins, aux, is_train, rng):
         x, gamma, beta = ins
         moving_mean, moving_var = aux
-        axes = (0,) if x.ndim == 2 else (0, 2, 3)
-        bshape = (1, -1) if x.ndim == 2 else (1, -1, 1, 1)
+        if x.ndim == 2:
+            axes, bshape = (0,), (1, -1)
+        else:
+            ch = self.axis % x.ndim
+            axes = tuple(i for i in range(x.ndim) if i != ch)
+            bshape = tuple(-1 if i == ch else 1 for i in range(x.ndim))
         g = (jnp.ones_like(gamma) if self.fix_gamma else gamma).astype(jnp.float32)
         b = beta.astype(jnp.float32)
-        xf = x.astype(jnp.float32)
         if is_train:
-            mean = jnp.mean(xf, axis=axes)
-            var = jnp.var(xf, axis=axes)
+            # One-pass stats: sibling sum / sum-of-squares reductions fuse
+            # into a single read of x. (jnp.var's two-pass E[(x-m)²] would
+            # read every activation a second time — a full extra HBM pass per
+            # BN layer, which at ResNet scale is ~10% of step time.)
+            n = 1
+            for a in axes:
+                n *= x.shape[a]
+            s1 = jnp.sum(x.astype(jnp.float32), axis=axes)
+            s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axes)
+            mean = s1 / n
+            var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
             new_mean = self.momentum * moving_mean + (1 - self.momentum) * mean
             new_var = self.momentum * moving_var + (1 - self.momentum) * var
             new_aux = [new_mean, new_var]
@@ -363,9 +417,11 @@ class BatchNormOp(OpProp):
             mean, var = moving_mean, moving_var
             new_aux = [moving_mean, moving_var]
         inv = lax.rsqrt(var + self.eps)
-        y = (xf - mean.reshape(bshape)) * inv.reshape(bshape) * g.reshape(
-            bshape
-        ) + b.reshape(bshape)
+        # y = x·scale + shift with per-channel f32 scalars; the fused
+        # elementwise kernel reads/writes bf16, intermediates stay on-core
+        scale = inv * g
+        shift = b - mean * scale
+        y = x.astype(jnp.float32) * scale.reshape(bshape) + shift.reshape(bshape)
         return [y.astype(x.dtype)], [lax.stop_gradient(a) for a in new_aux]
 
 

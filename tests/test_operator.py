@@ -331,3 +331,63 @@ def test_numpy_op():
     onehot = np.zeros((4, 5), np.float32)
     onehot[np.arange(4), lv.astype(int)] = 1
     _same(exe.grad_dict["data"].asnumpy(), p - onehot, tol=1e-4)
+
+
+def test_layout_nhwc_parity():
+    """NHWC ops must match NCHW numerics exactly (weights stay OIHW in both
+    layouts, so the same param values drive both graphs)."""
+    np.random.seed(3)
+    x_nchw = np.random.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (4, 3, 3, 3)).astype(np.float32)
+    b = np.random.uniform(-1, 1, (4,)).astype(np.float32)
+
+    def run(layout):
+        data = sym.Variable("data")
+        conv = sym.Convolution(data=data, name="c", kernel=(3, 3), pad=(1, 1),
+                               stride=(2, 2), num_filter=4, layout=layout)
+        bn = sym.BatchNorm(data=conv, name="b",
+                           axis=3 if layout == "NHWC" else 1)
+        act = sym.Activation(data=bn, act_type="relu")
+        pool = sym.Pooling(data=act, name="p", kernel=(2, 2), stride=(2, 2),
+                           pool_type="max", layout=layout)
+        gp = sym.Pooling(data=pool, name="g", kernel=(1, 1), pool_type="avg",
+                         global_pool=True, layout=layout)
+        net = sym.Flatten(data=gp)
+        x = x_nchw if layout == "NCHW" else x_nchw.transpose(0, 2, 3, 1)
+        exe = net.simple_bind(mx.cpu(), data=x.shape)
+        exe.arg_dict["data"][:] = x
+        exe.arg_dict["c_weight"][:] = w
+        exe.arg_dict["c_bias"][:] = b
+        exe.arg_dict["b_gamma"][:] = np.ones(4, np.float32)
+        exe.arg_dict["b_beta"][:] = np.zeros(4, np.float32)
+        (o,) = exe.forward(is_train=True)
+        exe.backward()
+        gw = exe.grad_dict["c_weight"].asnumpy()
+        return o.asnumpy(), gw, exe.aux_dict["b_moving_mean"].asnumpy()
+
+    o1, gw1, mm1 = run("NCHW")
+    o2, gw2, mm2 = run("NHWC")
+    _same(o1, o2, tol=1e-4)
+    _same(gw1, gw2, tol=1e-4)
+    _same(mm1, mm2, tol=1e-4)
+
+
+def test_deconvolution_nhwc_parity():
+    np.random.seed(4)
+    x_nchw = np.random.uniform(-1, 1, (2, 3, 5, 5)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (3, 4, 3, 3)).astype(np.float32)
+
+    def run(layout):
+        data = sym.Variable("data")
+        net = sym.Deconvolution(data=data, name="d", kernel=(3, 3),
+                                stride=(2, 2), pad=(1, 1), num_filter=4,
+                                layout=layout)
+        x = x_nchw if layout == "NCHW" else x_nchw.transpose(0, 2, 3, 1)
+        exe = net.simple_bind(mx.cpu(), data=x.shape)
+        exe.arg_dict["data"][:] = x
+        exe.arg_dict["d_weight"][:] = w
+        (o,) = exe.forward(is_train=False)
+        out = o.asnumpy()
+        return out if layout == "NCHW" else out.transpose(0, 3, 1, 2)
+
+    _same(run("NCHW"), run("NHWC"), tol=1e-4)
