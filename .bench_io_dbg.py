@@ -1,0 +1,8 @@
+import faulthandler, sys, time
+sys.path.insert(0, "/root/repo")
+faulthandler.dump_traceback_later(150, repeat=True, exit=False)
+sys.argv = ["bench.py", "--mode", "io", "--epochs", "2", "--num-images", "512"]
+import bench
+t0 = time.time()
+bench.main()
+print("elapsed", time.time() - t0)

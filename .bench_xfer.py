@@ -1,0 +1,7 @@
+import time, jax, numpy as np, jax.numpy as jnp
+x = np.random.randn(256,224,224,3).astype(np.float32)
+u = (np.random.rand(256,224,224,3)*255).astype(np.uint8)
+for arr, name in ((x,"f32 154MB"), (u,"u8 38MB")):
+    for i in range(2):
+        t0=time.perf_counter(); d = jax.device_put(arr); float(jnp.sum(d.astype(jnp.float32))); dt=time.perf_counter()-t0
+        print(f"{name} put+sum: {dt:.2f}s -> {arr.nbytes/dt/1e6:.0f} MB/s", flush=True)

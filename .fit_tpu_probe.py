@@ -1,0 +1,17 @@
+import sys, time, faulthandler
+sys.path.insert(0, "/root/repo")
+faulthandler.dump_traceback_later(150, repeat=True, exit=False)
+import numpy as np, jax.numpy as jnp
+import mxnet_tpu as mx
+from mxnet_tpu.models import resnet50
+
+n, b = 64, 32
+X = (np.random.rand(n, 224, 224, 3) * 255).astype(np.uint8)
+y = np.random.randint(0, 1000, n).astype(np.float32)
+model = mx.model.FeedForward(resnet50(num_classes=1000, layout="NHWC"),
+    ctx=mx.tpu(), num_epoch=2, learning_rate=0.01, momentum=0.9,
+    initializer=mx.init.Xavier(), compute_dtype=jnp.bfloat16)
+marks = [time.time()]
+def cb(*a): marks.append(time.time()); print("epoch end", marks[-1]-marks[0], flush=True)
+model.fit(X, y, batch_size=b, epoch_end_callback=cb)
+print("done", flush=True)

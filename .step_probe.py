@@ -1,0 +1,42 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt_mod, metric as metric_mod
+from mxnet_tpu.models import resnet50
+from mxnet_tpu import random as random_mod
+
+b = 256
+net = resnet50(num_classes=1000, layout="NHWC")
+model = mx.model.FeedForward(net, ctx=mx.tpu(), num_epoch=1,
+    learning_rate=0.01, momentum=0.9, initializer=mx.init.Xavier(),
+    compute_dtype=jnp.bfloat16)
+input_shapes = {"data": (b,224,224,3), "softmax_label": (b,)}
+param_names, aux_names = model._init_params(input_shapes)
+optimizer = opt_mod.create("sgd", rescale_grad=1.0/b, arg_names=param_names,
+                           learning_rate=0.01, momentum=0.9)
+em = metric_mod.create("accuracy")
+step = model._build_train_step(["data"], ["softmax_label"], optimizer, None,
+                               metric_update=em.device_update)
+params = {k: jnp.asarray(model.arg_params[k].asnumpy()) for k in param_names}
+aux = {k: jnp.asarray(model.aux_params[k].asnumpy()) for k in aux_names}
+opt_state = optimizer.init_state_tree(params)
+mstate = em.device_init()
+X = (np.random.rand(b,224,224,3)*255).astype(np.uint8)
+y = np.random.randint(0,1000,b).astype(np.float32)
+
+def mark(s, t0): print(f"{s}: {time.time()-t0:.1f}s", flush=True)
+t0=time.time()
+batch = {"data": jax.device_put(X), "softmax_label": jax.device_put(y)}
+jax.block_until_ready(batch["data"]); mark("device_put batch", t0)
+t0=time.time()
+rng = random_mod.next_key()
+params, opt_state, aux, outs, mstate = step(params, opt_state, aux, batch, rng, 0.01, mstate)
+mark("step dispatch (compile)", t0)
+t0=time.time(); print("mstate", jax.device_get(mstate)); mark("readback after step1", t0)
+for i in range(3):
+    t0=time.time()
+    rng = random_mod.next_key()
+    params, opt_state, aux, outs, mstate = step(params, opt_state, aux, batch, rng, 0.01, mstate)
+    mark(f"step{i+2} dispatch", t0)
+    t0=time.time(); jax.device_get(mstate); mark(f"readback {i+2}", t0)

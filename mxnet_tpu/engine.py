@@ -109,7 +109,8 @@ class Engine:
                 v._readers = []
                 v._tail = task.future
             self._inflight.add(task.future)
-            task.future.add_done_callback(self._on_done)
+            task.future.add_done_callback(
+                lambda f, reads=task.reads: self._on_done(f, reads))
 
         if self.synchronous:
             self._run(task)
@@ -168,10 +169,17 @@ class Engine:
         else:
             task.future.set_result(result)
 
-    def _on_done(self, fut):
+    def _on_done(self, fut, reads):
         with self._lock:
             self._inflight.discard(fut)
-        # Clear satisfied reader entries lazily; harmless if already replaced.
+            # Drop this read from its vars' reader lists so a long-lived
+            # read-only var doesn't accumulate finished futures (a writer
+            # may already have swapped the list out; absence is fine).
+            for v in reads:
+                try:
+                    v._readers.remove(fut)
+                except ValueError:
+                    pass
 
 
 _engine_lock = threading.Lock()

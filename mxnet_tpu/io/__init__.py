@@ -234,10 +234,30 @@ class ImageRecordIter(DataIter):
                  random_l=0, fill_value=255,
                  num_parts=1, part_index=0, round_batch=True, seed=0,
                  preprocess_threads=None, prefetch_buffer=4, path_imglist=None,
-                 **_ignored):
+                 layout="NCHW", output_dtype="float32", **_ignored):
         super().__init__()
         from .. import recordio as rio
 
+        if layout not in ("NCHW", "NHWC"):
+            raise MXNetError(f"ImageRecordIter: layout must be NCHW or NHWC, got {layout!r}")
+        if output_dtype not in ("float32", "uint8"):
+            raise MXNetError(
+                f"ImageRecordIter: output_dtype must be float32 or uint8, got {output_dtype!r}")
+        # data_shape stays (c, h, w) for reference parity; ``layout`` only
+        # selects the emitted batch layout (NHWC = TPU fast path, and cheaper
+        # to produce: decoded pixels are already HWC).
+        # output_dtype="uint8" emits raw pixels — 4x less host->device
+        # traffic, the standard TPU input path; normalization then belongs on
+        # the device (pair with compute_dtype=bfloat16 in FeedForward, which
+        # casts the batch in-graph).
+        self.layout = layout
+        self.output_dtype = output_dtype
+        if output_dtype == "uint8" and (
+                mean_img is not None or mean_r or mean_g or mean_b
+                or scale != 1.0):
+            raise MXNetError(
+                "ImageRecordIter: output_dtype='uint8' emits raw pixels; "
+                "mean/scale normalization must run on the device instead")
         self.data_shape = tuple(data_shape)
         self.batch_size = batch_size
         self.label_width = label_width
@@ -313,7 +333,9 @@ class ImageRecordIter(DataIter):
                     rand_mirror=rand_mirror, resize=resize,
                     mean=(self._mean.ravel() if self._mean is not None else None),
                     scale=scale, shuffle=shuffle, seed=seed,
-                    prefetch=self._prefetch_depth, round_batch=round_batch)
+                    prefetch=self._prefetch_depth, round_batch=round_batch,
+                    nhwc=(self.layout == "NHWC"),
+                    out_u8=(self.output_dtype == "uint8"))
                 # probe one batch: raises on undecodable payloads
                 self._native_first = pipe.next()
                 self._native = pipe
@@ -439,12 +461,17 @@ class ImageRecordIter(DataIter):
             img = img[:, ::-1]
         if self.random_h or self.random_s or self.random_l:
             img = self._hsl_jitter(img, rng)
-        img = img.transpose(2, 0, 1)  # HWC -> CHW
-        if self._mean is not None:
-            img = img - (self._mean if self._mean.ndim == 3 else self._mean.reshape(3, 1, 1))
+        if self.layout == "NHWC":
+            if self._mean is not None:
+                mean = self._mean if self._mean.ndim == 3 else self._mean.reshape(3, 1, 1)
+                img = img - mean.transpose(1, 2, 0)  # CHW mean -> HWC
+        else:
+            img = img.transpose(2, 0, 1)  # HWC -> CHW
+            if self._mean is not None:
+                img = img - (self._mean if self._mean.ndim == 3 else self._mean.reshape(3, 1, 1))
         img = img * self.scale
         label = header.label if header.flag > 0 else np.float32(header.label)
-        return img.astype(np.float32), label
+        return img.astype(self._np_dtype), label
 
     def _hsl_jitter(self, img, rng):
         """Random hue/lightness/saturation shifts in HLS space (reference:
@@ -503,7 +530,7 @@ class ImageRecordIter(DataIter):
 
         def produce(offs=offs, pad=pad, task_seed=task_seed):
             rng = np.random.RandomState(task_seed)
-            data = np.empty((len(offs),) + self.data_shape, np.float32)
+            data = np.empty((len(offs),) + self._batch_shape, self._np_dtype)
             labels = np.empty(
                 (len(offs),) if self.label_width == 1 else (len(offs), self.label_width),
                 np.float32,
@@ -528,21 +555,32 @@ class ImageRecordIter(DataIter):
             else:
                 data, labels, pad = self._native.next()  # raises StopIteration
             self._pad = pad
-            return DataBatch([array(data)], [array(labels)], pad=pad)
+            return DataBatch([array(data, dtype=data.dtype)],
+                             [array(labels)], pad=pad)
         if not self._pending:
             raise StopIteration
         fut = self._pending.pop(0)
         data, labels, pad = fut.result()
         self._enqueue()
         self._pad = pad
-        return DataBatch([array(data)], [array(labels)], pad=pad)
+        return DataBatch([array(data, dtype=data.dtype)],
+                         [array(labels)], pad=pad)
 
     def getpad(self):
         return self._pad
 
     @property
+    def _np_dtype(self):
+        return np.uint8 if self.output_dtype == "uint8" else np.float32
+
+    @property
+    def _batch_shape(self):
+        c, h, w = self.data_shape
+        return (h, w, c) if self.layout == "NHWC" else (c, h, w)
+
+    @property
     def provide_data(self):
-        return [("data", (self.batch_size,) + self.data_shape)]
+        return [("data", (self.batch_size,) + self._batch_shape)]
 
     @property
     def provide_label(self):

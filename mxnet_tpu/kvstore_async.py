@@ -1,0 +1,274 @@
+"""True ``dist_async``: an update-on-arrival parameter server.
+
+Reference counterpart: src/kvstore/kvstore_dist_server.h:194-202 — in async
+mode the server applies every worker push to the stored weights immediately
+(no accumulate-until-N), so workers run at their own pace with unbounded
+staleness (consistency table: doc/developer-guide/multi_node.md:21-27).
+
+TPU-native placement: asynchronous updates cannot live inside an SPMD
+program (a psum is inherently bulk-synchronous), so the parameter host runs
+on the CPU side — a small TCP server hosted by worker rank 0, exactly where
+the reference runs its ps-lite server processes. Workers push/pull numpy
+buffers over persistent sockets; the optimizer is pickled to the server
+(reference: python/mxnet/kvstore.py:231-256 pickled-optimizer transport) and
+runs there on arrival. Launcher ``-s`` server processes still retire at
+import (kvstore_server.py): the async host needs no dedicated process.
+
+This path is for the explicit ``create('dist_async')`` API; synchronous
+training should prefer ``dist_sync`` (in-jit psum over the mesh), which is
+the idiomatic TPU fast path.
+
+Wire protocol: 4-byte big-endian length + pickle of (op, *args); one reply
+per request. Ops: init / push / pull / set_optimizer / barrier / stop.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+from .kvstore import KVStore, wrap_np_updater
+from .ndarray import NDArray
+
+__all__ = ["AsyncKVStore"]
+
+_MAGIC = b"mxta"
+
+
+def _send_msg(sock, obj):
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">I", len(blob)) + blob)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _AsyncServer:
+    """The parameter host: applies pushes on arrival under one lock per key
+    space (the reference serializes updater calls on one Executor thread,
+    kvstore_dist_server.h:28-85 — a single mutex gives the same guarantee)."""
+
+    def __init__(self, host, port, num_workers):
+        self.num_workers = num_workers
+        self.store: dict = {}
+        self.updater = None
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self._barrier_count = 0
+        self._barrier_round = 0
+        self._stopped = 0
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(max(8, num_workers * 2))
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            if _recv_exact(conn, 4) != _MAGIC:
+                conn.close()
+                continue
+            conn.sendall(_MAGIC)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg[0]
+                if op == "init":
+                    _, key, value = msg
+                    with self.lock:
+                        # first init wins (reference: rank 0 initializes)
+                        self.store.setdefault(key, np.array(value, np.float32))
+                    _send_msg(conn, ("ok",))
+                elif op == "push":
+                    _, key, value = msg
+                    with self.lock:
+                        if key not in self.store:
+                            _send_msg(conn, ("err", f"key {key!r} not initialized"))
+                            continue
+                        # update-on-arrival: no waiting for other workers
+                        if self.updater is not None:
+                            self.updater(key, np.asarray(value, np.float32),
+                                         self.store[key])
+                        else:
+                            self.store[key] = np.array(value, np.float32)
+                    _send_msg(conn, ("ok",))
+                elif op == "pull":
+                    _, key = msg
+                    with self.lock:
+                        if key not in self.store:
+                            _send_msg(conn, ("err", f"key {key!r} not initialized"))
+                            continue
+                        _send_msg(conn, ("ok", self.store[key].copy()))
+                elif op == "set_optimizer":
+                    _, blob = msg
+                    from .optimizer import get_updater
+
+                    opt = pickle.loads(blob)
+                    with self.lock:
+                        self.updater = wrap_np_updater(get_updater(opt))
+                    _send_msg(conn, ("ok",))
+                elif op == "barrier":
+                    with self.cv:
+                        my_round = self._barrier_round
+                        self._barrier_count += 1
+                        if self._barrier_count == self.num_workers:
+                            self._barrier_count = 0
+                            self._barrier_round += 1
+                            self.cv.notify_all()
+                        else:
+                            self.cv.wait_for(
+                                lambda: self._barrier_round > my_round)
+                    _send_msg(conn, ("ok",))
+                elif op == "stop":
+                    with self.lock:
+                        self._stopped += 1
+                        done = self._stopped >= self.num_workers
+                    _send_msg(conn, ("ok",))
+                    if done:
+                        self._srv.close()
+                    return
+                else:
+                    _send_msg(conn, ("err", f"unknown op {op!r}"))
+        except (ConnectionError, OSError):
+            return
+
+
+class AsyncKVStore(KVStore):
+    """Worker handle for ``create('dist_async')``.
+
+    Rank/world come from the launcher env (MXTPU_WORKER_RANK /
+    MXTPU_NUM_WORKERS, tools/launch.py) — the async path needs no
+    jax.distributed collectives, only the parameter-host socket."""
+
+    def __init__(self):
+        super().__init__("dist_async")
+        self._rank = int(os.environ.get("MXTPU_WORKER_RANK", "0"))
+        self._nproc = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
+        host, port = self._server_addr()
+        self._server = None
+        if self._rank == 0:
+            self._server = _AsyncServer(host, port, self._nproc)
+        self._sock = self._connect(host, port)
+        self._lock = threading.Lock()
+
+    def _server_addr(self):
+        coord = os.environ.get("MXTPU_COORDINATOR")
+        if coord:
+            host, port = coord.rsplit(":", 1)
+            # deterministic offset from the coordination-service port
+            return host, int(port) + 1
+        # standalone single process: loopback on an os-assigned port
+        if self._nproc != 1:
+            raise MXNetError(
+                "dist_async needs the launcher environment "
+                "(tools/launch.py sets MXTPU_COORDINATOR)")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return "127.0.0.1", s.getsockname()[1]
+
+    def _connect(self, host, port, timeout=60.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                sock = socket.create_connection((host, port), timeout=5.0)
+                sock.sendall(_MAGIC)
+                if _recv_exact(sock, 4) == _MAGIC:
+                    sock.settimeout(None)
+                    return sock
+                sock.close()
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise MXNetError(
+                        f"dist_async: cannot reach parameter host at "
+                        f"{host}:{port}") from None
+                time.sleep(0.2)
+
+    def _call(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply[0] != "ok":
+            raise MXNetError(f"dist_async server: {reply[1]}")
+        return reply[1] if len(reply) > 1 else None
+
+    # -- API ------------------------------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._nproc
+
+    def init(self, key, value):
+        for k, v in self._as_pairs(key, value):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            if self._rank == 0:
+                self._call("init", k, v.asnumpy())
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        del priority
+        for k, vlist in self._as_pairs(key, value):
+            merged = self._merge(vlist)
+            self._call("push", k, merged.asnumpy())
+
+    def pull(self, key, out, priority=0):
+        del priority
+        for k, outs in self._as_pairs(key, out):
+            value = self._call("pull", k)
+            if isinstance(outs, NDArray):
+                outs = [outs]
+            for o in outs:
+                NDArray(value).copyto(o)
+
+    def set_updater(self, updater):
+        raise MXNetError(
+            "dist_async runs the updater on the parameter host; ship the "
+            "optimizer with set_optimizer() (reference: pickled-optimizer "
+            "transport, python/mxnet/kvstore.py:231-256)")
+
+    def set_optimizer(self, optimizer):
+        self._call("set_optimizer",
+                   pickle.dumps(optimizer, protocol=pickle.HIGHEST_PROTOCOL))
+
+    def barrier(self):
+        self._call("barrier")
+
+    def __del__(self):
+        try:
+            self._call("stop")
+            self._sock.close()
+        except Exception:  # interpreter teardown
+            pass

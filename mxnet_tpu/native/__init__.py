@@ -56,10 +56,11 @@ def get_lib():
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.c_float, ctypes.c_int,
-            ctypes.c_uint32, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+            ctypes.c_uint32, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
         lib.mxtpu_pipeline_next.restype = ctypes.c_int
         lib.mxtpu_pipeline_next.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_void_p, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int)]
         lib.mxtpu_pipeline_reset.argtypes = [ctypes.c_void_p]
         lib.mxtpu_pipeline_batches.restype = ctypes.c_int64
@@ -91,7 +92,10 @@ class NativePipeline:
     def __init__(self, path, offsets, batch, data_shape, label_width=1,
                  rand_crop=False, rand_mirror=False, resize=-1, mean=None,
                  scale=1.0, shuffle=False, seed=0, num_threads=None,
-                 prefetch=4, round_batch=True):
+                 prefetch=4, round_batch=True, nhwc=False, out_u8=False):
+        if out_u8 and (mean is not None or scale != 1.0):
+            raise ValueError("uint8 output emits raw pixels: mean/scale "
+                             "must be left for the device side")
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native library unavailable")
@@ -106,24 +110,31 @@ class NativePipeline:
             mean_ptr = mean_arr
         num_threads = num_threads or max(1, (os.cpu_count() or 2) - 1)
         c, h, w = self.data_shape
+        self.nhwc = bool(nhwc)
+        self.out_u8 = bool(out_u8)
         self._handle = lib.mxtpu_pipeline_create(
             path.encode(), off, len(offsets), batch, c, h, w, label_width,
             int(rand_crop), int(rand_mirror), int(resize), mean_ptr,
             float(scale), int(shuffle), int(seed) & 0xFFFFFFFF,
-            num_threads, prefetch, int(round_batch))
+            num_threads, prefetch, int(round_batch), int(self.nhwc),
+            int(self.out_u8))
         if not self._handle:
             raise RuntimeError(f"failed to open native pipeline on {path!r}")
 
     def next(self):
-        """Returns (data NCHW f32, labels f32, pad) or raises StopIteration."""
-        data = np.empty((self.batch,) + self.data_shape, np.float32)
+        """Returns (data in NCHW — or NHWC when so configured — f32, or raw
+        uint8 under out_u8; labels f32; pad) or raises StopIteration."""
+        c, h, w = self.data_shape
+        batch_shape = (h, w, c) if self.nhwc else (c, h, w)
+        dtype = np.uint8 if self.out_u8 else np.float32
+        data = np.empty((self.batch,) + batch_shape, dtype)
         shape = (self.batch,) if self.label_width == 1 else \
             (self.batch, self.label_width)
         labels = np.empty(shape, np.float32)
         pad = ctypes.c_int(0)
         rc = self._lib.mxtpu_pipeline_next(
             self._handle,
-            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            data.ctypes.data_as(ctypes.c_void_p),
             labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             ctypes.byref(pad))
         if rc == 1:

@@ -106,14 +106,21 @@ def load_sharded(directory, step=None, shardings=None):
             raise FileNotFoundError(f"no checkpoints under {directory}")
     step_dir = os.path.join(directory, str(int(step)))
 
-    restore_args = None
-    if shardings is not None:
-        import orbax.checkpoint as ocp
+    import orbax.checkpoint as ocp
 
+    ckptr = _checkpointer()
+    state_path = os.path.join(step_dir, _STATE_DIR)
+    if shardings is not None:
         restore_args = jax.tree_util.tree_map(
             lambda s: ocp.ArrayRestoreArgs(sharding=s), shardings)
-    state = _checkpointer().restore(os.path.join(step_dir, _STATE_DIR),
-                                    restore_args=restore_args)
+    else:
+        # Explicit numpy restore args: without them orbax restores with the
+        # *saved* shardings and warns that this is unsafe across topologies —
+        # the host-numpy default must not depend on the saving mesh.
+        meta_tree = ckptr.metadata(state_path).item_metadata.tree
+        restore_args = jax.tree_util.tree_map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree)
+    state = ckptr.restore(state_path, restore_args=restore_args)
     params = state.get("params", {})
     aux = state.get("aux", {})
     opt_leaves = state.get("opt")

@@ -115,3 +115,22 @@ def test_naive_engine_synchronous():
 def test_push_sync_returns_value():
     eng = Engine(num_workers=2)
     assert eng.push_sync(lambda: 42) == 42
+
+
+def test_reader_list_does_not_leak():
+    """Finished read tasks must leave the var's reader list (a long-lived
+    read-only var previously accumulated every read future)."""
+    from mxnet_tpu.engine import Engine
+
+    eng = Engine(num_workers=2)
+    v = eng.new_variable("hot")
+    for _ in range(200):
+        eng.push(lambda: None, read_vars=(v,)).result()
+    eng.wait_for_all()
+    # allow stragglers' done-callbacks to fire
+    import time
+    for _ in range(50):
+        if not v._readers:
+            break
+        time.sleep(0.01)
+    assert len(v._readers) == 0

@@ -63,3 +63,21 @@ def test_launcher_accepts_server_processes():
         capture_output=True, text=True, timeout=240, env=env)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "dist_sync semantics OK" in res.stdout
+
+
+@pytest.mark.slow
+def test_dist_async_kvstore_2proc():
+    """Real update-on-arrival async PS: rank 0 pushes+pulls while rank 1 sits
+    at a barrier — would deadlock under BSP (reference async semantics:
+    kvstore_dist_server.h:194-202)."""
+    script = os.path.join(REPO, "examples", "distributed",
+                          "dist_async_kvstore.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", sys.executable, script],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert res.stdout.count("dist_async semantics OK (value = 5)") == 2, \
+        res.stdout + res.stderr[-2000:]

@@ -149,3 +149,50 @@ def test_image_record_iter_falls_back_for_png(tmp_path):
     assert it._native is None, "PNG records must fall back to the PIL path"
     labels = [x for b in it for x in b.label[0].asnumpy().tolist()]
     assert labels == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_image_record_iter_nhwc_layout(tmp_path):
+    """NHWC batches must be the exact transpose of NCHW batches — native path
+    (and provide_data must advertise the NHWC shape)."""
+    path, _, _ = _make_jpeg_rec(tmp_path, n=12, size=40)
+    kw = dict(path_imgrec=path, data_shape=(3, 32, 32), batch_size=4,
+              shuffle=False)
+    it_c = mio.ImageRecordIter(layout="NCHW", **kw)
+    it_h = mio.ImageRecordIter(layout="NHWC", **kw)
+    assert it_c._native is not None and it_h._native is not None
+    assert it_h.provide_data == [("data", (4, 32, 32, 3))]
+    for bc, bh in zip(it_c, it_h):
+        np.testing.assert_allclose(
+            bc.data[0].asnumpy(), bh.data[0].asnumpy().transpose(0, 3, 1, 2))
+        np.testing.assert_allclose(bc.label[0].asnumpy(), bh.label[0].asnumpy())
+
+
+def test_image_record_iter_nhwc_layout_python_path(tmp_path, monkeypatch):
+    """Same parity on the pure-Python decode path (native disabled)."""
+    monkeypatch.setenv("MXNET_TPU_NATIVE_IO", "0")
+    path, _, _ = _make_jpeg_rec(tmp_path, n=8, size=40)
+    kw = dict(path_imgrec=path, data_shape=(3, 32, 32), batch_size=4,
+              shuffle=False, mean_r=10.0, mean_g=20.0, mean_b=30.0, scale=0.5)
+    it_c = mio.ImageRecordIter(layout="NCHW", **kw)
+    it_h = mio.ImageRecordIter(layout="NHWC", **kw)
+    assert it_c._native is None and it_h._native is None
+    for bc, bh in zip(it_c, it_h):
+        np.testing.assert_allclose(
+            bc.data[0].asnumpy(), bh.data[0].asnumpy().transpose(0, 3, 1, 2))
+
+
+def test_image_record_iter_uint8_output(tmp_path):
+    """output_dtype='uint8' emits raw pixels equal to the f32 path at
+    scale=1/no-mean, in both native and python pipelines."""
+    path, _, _ = _make_jpeg_rec(tmp_path, n=8, size=40)
+    kw = dict(path_imgrec=path, data_shape=(3, 32, 32), batch_size=4,
+              shuffle=False, layout="NHWC")
+    it_f = mio.ImageRecordIter(output_dtype="float32", **kw)
+    it_u = mio.ImageRecordIter(output_dtype="uint8", **kw)
+    assert it_u._native is not None
+    for bf, bu in zip(it_f, it_u):
+        u = bu.data[0].asnumpy()
+        assert u.dtype == np.uint8
+        np.testing.assert_allclose(bf.data[0].asnumpy(), u.astype(np.float32))
+    with pytest.raises(mx.base.MXNetError):
+        mio.ImageRecordIter(output_dtype="uint8", scale=0.5, **kw)
