@@ -47,6 +47,7 @@ def main():
     out = mx.nd.empty(SHAPE)
     kv.pull(KEY, out=out)
     np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 3.0))
+    kv.barrier()  # all pulls above land before anyone's next push
     # now every worker pushes once; total becomes 3 + nworker regardless of
     # arrival order (sum is order-independent; no BSP rounds involved)
     kv.push(KEY, [mx.nd.ones(SHAPE)])

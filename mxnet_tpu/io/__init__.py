@@ -30,6 +30,7 @@ import numpy as np
 from ..base import MXNetError, env_int
 from ..engine import engine
 from ..ndarray import NDArray, array
+from ..params import REQUIRED, Range, TupleParam, apply_params, autodoc
 
 __all__ = ["DataBatch", "DataIter", "NDArrayIter", "MNISTIter", "ImageRecordIter",
            "PrefetchingIter", "CSVIter"]
@@ -175,10 +176,27 @@ class MNISTIter(DataIter):
     """MNIST idx-format loader (reference: src/io/iter_mnist.cc) with
     flat/4-D output, shuffle, silent=?, and num_parts/part_index sharding."""
 
-    def __init__(self, image, label, batch_size=128, shuffle=False, flat=False,
-                 seed=0, silent=True, num_parts=1, part_index=0,
-                 input_shape=None, **_ignored):
+    params = {
+        "image": (str, REQUIRED, "idx-format image file (.gz ok)"),
+        "label": (str, REQUIRED, "idx-format label file (.gz ok)"),
+        "batch_size": (Range(int, lo=1), 128, "batch size"),
+        "shuffle": (bool, False, "shuffle each epoch"),
+        "flat": (bool, False, "emit (n, 784) instead of (n, 1, 28, 28)"),
+        "seed": (int, 0, "shuffle RNG seed"),
+        "silent": (bool, True, "suppress loading logs (parity flag)"),
+        "num_parts": (Range(int, lo=1), 1, "number of distributed shards"),
+        "part_index": (Range(int, lo=0), 0, "this worker's shard index"),
+        "input_shape": (TupleParam(3), None, "reshape images to this (c, h, w)"),
+    }
+
+    def __init__(self, **kwargs):
         super().__init__()
+        cfg = apply_params(type(self).__name__, type(self).params, kwargs)
+        image, label = cfg["image"], cfg["label"]
+        batch_size, shuffle, flat = cfg["batch_size"], cfg["shuffle"], cfg["flat"]
+        seed = cfg["seed"]
+        num_parts, part_index = cfg["num_parts"], cfg["part_index"]
+        input_shape = cfg["input_shape"]
         images = _read_idx_file(image).astype(np.float32) / 255.0
         labels = _read_idx_file(label).astype(np.float32)
         # partition for distributed workers (InputSplit semantics)
@@ -226,23 +244,75 @@ class ImageRecordIter(DataIter):
     (PrefetcherIter semantics).
     """
 
-    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
-                 shuffle=False, mean_img=None, mean_r=0.0, mean_g=0.0, mean_b=0.0,
-                 scale=1.0, rand_crop=False, rand_mirror=False, resize=-1,
-                 max_rotate_angle=0, max_aspect_ratio=0.0, max_shear_ratio=0.0,
-                 min_crop_size=-1, max_crop_size=-1, random_h=0, random_s=0,
-                 random_l=0, fill_value=255,
-                 num_parts=1, part_index=0, round_batch=True, seed=0,
-                 preprocess_threads=None, prefetch_buffer=4, path_imglist=None,
-                 layout="NCHW", output_dtype="float32", **_ignored):
+    params = {
+        "path_imgrec": (str, REQUIRED, "RecordIO shard path"),
+        "data_shape": (TupleParam(3), REQUIRED,
+                       "(c, h, w) emitted image shape (CHW for reference "
+                       "parity; ``layout`` selects the batch layout)"),
+        "batch_size": (Range(int, lo=1), REQUIRED, "batch size"),
+        "label_width": (Range(int, lo=1), 1, "labels per record"),
+        "shuffle": (bool, False, "shuffle record order each epoch"),
+        "mean_img": (str, None, "mean-image cache path (computed+saved on "
+                                "first use, loaded after)"),
+        "mean_r": (float, 0.0, "per-channel mean (red)"),
+        "mean_g": (float, 0.0, "per-channel mean (green)"),
+        "mean_b": (float, 0.0, "per-channel mean (blue)"),
+        "scale": (float, 1.0, "multiplier applied after mean subtraction"),
+        "rand_crop": (bool, False, "random (vs center) crop"),
+        "rand_mirror": (bool, False, "random horizontal flip"),
+        "resize": (int, -1, "resize shorter side to this before crop (-1 off)"),
+        "max_rotate_angle": (Range(int, lo=0), 0, "max random rotation (deg)"),
+        "max_aspect_ratio": (Range(float, lo=0.0), 0.0, "max aspect jitter"),
+        "max_shear_ratio": (Range(float, lo=0.0), 0.0, "max shear jitter"),
+        "min_crop_size": (int, -1, "min random crop size (-1 off)"),
+        "max_crop_size": (int, -1, "max random crop size (-1 off)"),
+        "random_h": (Range(int, lo=0), 0, "max hue jitter (degrees)"),
+        "random_s": (Range(int, lo=0), 0, "max saturation jitter (0-255)"),
+        "random_l": (Range(int, lo=0), 0, "max lightness jitter (0-255)"),
+        "fill_value": (Range(int, lo=0, hi=255), 255, "border fill value"),
+        "num_parts": (Range(int, lo=1), 1, "number of distributed shards"),
+        "part_index": (Range(int, lo=0), 0, "this worker's shard index"),
+        "round_batch": (bool, True, "wrap the last batch around the epoch"),
+        "seed": (int, 0, "augmentation/shuffle RNG seed"),
+        "preprocess_threads": (int, None, "decode worker threads "
+                                          "(default: native pipeline picks)"),
+        "prefetch_buffer": (Range(int, lo=1), 4, "prefetched batches"),
+        "path_imglist": (str, None, "accepted for parity (unused: labels "
+                                    "ride in the RecordIO headers)"),
+        "layout": (("NCHW", "NHWC"), "NCHW",
+                   "emitted batch layout (NHWC = TPU fast path)"),
+        "output_dtype": (("float32", "uint8"), "float32",
+                         "batch dtype (uint8 = raw pixels, 4x less "
+                         "host->device traffic; normalize on device)"),
+    }
+
+    def __init__(self, **kwargs):
         super().__init__()
         from .. import recordio as rio
 
-        if layout not in ("NCHW", "NHWC"):
-            raise MXNetError(f"ImageRecordIter: layout must be NCHW or NHWC, got {layout!r}")
-        if output_dtype not in ("float32", "uint8"):
-            raise MXNetError(
-                f"ImageRecordIter: output_dtype must be float32 or uint8, got {output_dtype!r}")
+        cfg = apply_params(type(self).__name__, type(self).params, kwargs)
+        path_imgrec = cfg["path_imgrec"]
+        data_shape = cfg["data_shape"]
+        batch_size = cfg["batch_size"]
+        label_width = cfg["label_width"]
+        shuffle = cfg["shuffle"]
+        mean_img = cfg["mean_img"]
+        mean_r, mean_g, mean_b = cfg["mean_r"], cfg["mean_g"], cfg["mean_b"]
+        scale = cfg["scale"]
+        rand_crop, rand_mirror = cfg["rand_crop"], cfg["rand_mirror"]
+        resize = cfg["resize"]
+        max_rotate_angle = cfg["max_rotate_angle"]
+        max_aspect_ratio = cfg["max_aspect_ratio"]
+        max_shear_ratio = cfg["max_shear_ratio"]
+        min_crop_size, max_crop_size = cfg["min_crop_size"], cfg["max_crop_size"]
+        random_h, random_s, random_l = cfg["random_h"], cfg["random_s"], cfg["random_l"]
+        fill_value = cfg["fill_value"]
+        num_parts, part_index = cfg["num_parts"], cfg["part_index"]
+        round_batch = cfg["round_batch"]
+        seed = cfg["seed"]
+        prefetch_buffer = cfg["prefetch_buffer"]
+        layout = cfg["layout"]
+        output_dtype = cfg["output_dtype"]
         # data_shape stays (c, h, w) for reference parity; ``layout`` only
         # selects the emitted batch layout (NHWC = TPU fast path, and cheaper
         # to produce: decoded pixels are already HWC).
@@ -286,10 +356,14 @@ class ImageRecordIter(DataIter):
         self.round_batch = round_batch
         self._rng = np.random.RandomState(seed)
         self._mean = None
-        if mean_img is not None and os.path.exists(mean_img):
-            from ..ndarray import load as nd_load
+        compute_mean = None
+        if mean_img is not None:
+            if os.path.exists(mean_img):
+                from ..ndarray import load as nd_load
 
-            self._mean = nd_load(mean_img)["mean_img"].asnumpy()
+                self._mean = nd_load(mean_img)["mean_img"].asnumpy()
+            else:
+                compute_mean = mean_img  # cold path: one pass below, cached
         elif mean_r or mean_g or mean_b:
             self._mean = np.array([mean_r, mean_g, mean_b], np.float32).reshape(3, 1, 1)
 
@@ -313,6 +387,15 @@ class ImageRecordIter(DataIter):
         self._path = path_imgrec
         self._prefetch_depth = max(1, min(int(prefetch_buffer), 16))
         self._pad = 0
+        if compute_mean is not None:
+            if part_index == 0:
+                # over ALL records (not this worker's shard) so every
+                # distributed worker normalizes identically
+                self._mean = self._compute_and_cache_mean(compute_mean, offsets)
+            else:
+                # other shards wait for worker 0's cache rather than each
+                # decoding the full dataset redundantly
+                self._mean = self._wait_for_mean(compute_mean)
 
         # Prefer the native C++ pipeline (RecordIO + libjpeg decode + augment
         # in worker threads, mxnet_tpu/native) when the records are JPEG and
@@ -343,6 +426,67 @@ class ImageRecordIter(DataIter):
                 self._native = None
                 self._native_first = None
         self.reset()
+
+    def _compute_and_cache_mean(self, path, offsets):
+        """One deterministic pass over the full record file computing the mean
+        image at ``data_shape`` (resize-short + center crop, no random
+        augmentation), cached to ``path`` for later runs — parity with the
+        reference's compute-then-save behavior (src/io/iter_normalize.h:98
+        loads, :150 saves after the first pass). Stored CHW under key
+        "mean_img" in the framework's NDArray save format. The write is
+        atomic (tmp + rename) and a cache file that appeared meanwhile (a
+        racing distributed worker) is loaded instead — all workers compute
+        the identical full-dataset mean either way."""
+        import logging
+
+        from PIL import Image
+
+        from .. import recordio as rio
+        from ..ndarray import array as nd_array, load as nd_load, \
+            save as nd_save
+
+        c, th, tw = self.data_shape
+        acc = np.zeros((th, tw, c), np.float64)
+        with open(self._path, "rb") as f:
+            for off in offsets:
+                raw = rio.read_record_at(f, off)
+                _, img = rio.unpack_img(raw)
+                h, w = img.shape[:2]
+                if self.resize > 0:
+                    s = self.resize / min(h, w)
+                    img = np.asarray(Image.fromarray(img).resize(
+                        (max(tw, int(w * s)), max(th, int(h * s)))))
+                    h, w = img.shape[:2]
+                if h < th or w < tw:
+                    img = np.asarray(Image.fromarray(img).resize((tw, th)))
+                    h, w = img.shape[:2]
+                top, left = (h - th) // 2, (w - tw) // 2
+                acc += img[top:top + th, left:left + tw].astype(np.float64)
+        mean = (acc / len(offsets)).astype(np.float32).transpose(2, 0, 1)
+        if os.path.exists(path):  # another worker won the race: use its file
+            return nd_load(path)["mean_img"].asnumpy()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        nd_save(tmp, {"mean_img": nd_array(mean)})
+        os.replace(tmp, path)
+        logging.info("ImageRecordIter: computed mean image over %d records, "
+                     "saved to %s", len(offsets), path)
+        return mean
+
+    def _wait_for_mean(self, path, timeout=3600.0, poll=1.0):
+        """Poll for worker 0's mean cache (os.replace makes it appear
+        atomically and complete)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while not os.path.exists(path):
+            if _time.monotonic() > deadline:
+                raise MXNetError(
+                    f"timed out waiting for mean image cache {path!r} "
+                    "(is the part_index=0 worker running?)")
+            _time.sleep(poll)
+        from ..ndarray import load as nd_load
+
+        return nd_load(path)["mean_img"].asnumpy()
 
     def _mean_is_rgb(self):
         return self._mean is None or self._mean.size == 3
@@ -591,8 +735,19 @@ class ImageRecordIter(DataIter):
 class CSVIter(DataIter):
     """Batches from CSV files (reference family: dmlc data/InputSplit CSV)."""
 
-    def __init__(self, data_csv, data_shape, label_csv=None, batch_size=128, **_ignored):
+    params = {
+        "data_csv": (str, REQUIRED, "CSV file of flattened rows"),
+        "data_shape": (TupleParam(), REQUIRED, "per-row shape"),
+        "label_csv": (str, None, "CSV label file (zeros when absent)"),
+        "batch_size": (Range(int, lo=1), 128, "batch size"),
+        "round_batch": (bool, True, "accepted for parity"),
+    }
+
+    def __init__(self, **kwargs):
         super().__init__()
+        cfg = apply_params(type(self).__name__, type(self).params, kwargs)
+        data_csv, data_shape = cfg["data_csv"], cfg["data_shape"]
+        label_csv, batch_size = cfg["label_csv"], cfg["batch_size"]
         data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
         data = data.reshape((-1,) + tuple(data_shape))
         label = (
@@ -673,3 +828,9 @@ class PrefetchingIter(DataIter):
     @property
     def provide_label(self):
         return self._iter.provide_label
+
+
+# dmlc-parity: generated Parameters docs on the declarative iterators
+for _cls in (MNISTIter, ImageRecordIter, CSVIter):
+    autodoc(_cls)
+del _cls

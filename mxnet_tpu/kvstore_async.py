@@ -100,65 +100,76 @@ class _AsyncServer:
         try:
             while True:
                 msg = _recv_msg(conn)
-                op = msg[0]
-                if op == "init":
-                    _, key, value = msg
-                    with self.lock:
-                        # first init wins (reference: rank 0 initializes)
-                        self.store.setdefault(key, np.array(value, np.float32))
-                    _send_msg(conn, ("ok",))
-                elif op == "push":
-                    _, key, value = msg
-                    with self.lock:
-                        if key not in self.store:
-                            _send_msg(conn, ("err", f"key {key!r} not initialized"))
-                            continue
-                        # update-on-arrival: no waiting for other workers
-                        if self.updater is not None:
-                            self.updater(key, np.asarray(value, np.float32),
-                                         self.store[key])
-                        else:
-                            self.store[key] = np.array(value, np.float32)
-                    _send_msg(conn, ("ok",))
-                elif op == "pull":
-                    _, key = msg
-                    with self.lock:
-                        if key not in self.store:
-                            _send_msg(conn, ("err", f"key {key!r} not initialized"))
-                            continue
-                        _send_msg(conn, ("ok", self.store[key].copy()))
-                elif op == "set_optimizer":
-                    _, blob = msg
-                    from .optimizer import get_updater
-
-                    opt = pickle.loads(blob)
-                    with self.lock:
-                        self.updater = wrap_np_updater(get_updater(opt))
-                    _send_msg(conn, ("ok",))
-                elif op == "barrier":
-                    with self.cv:
-                        my_round = self._barrier_round
-                        self._barrier_count += 1
-                        if self._barrier_count == self.num_workers:
-                            self._barrier_count = 0
-                            self._barrier_round += 1
-                            self.cv.notify_all()
-                        else:
-                            self.cv.wait_for(
-                                lambda: self._barrier_round > my_round)
-                    _send_msg(conn, ("ok",))
-                elif op == "stop":
-                    with self.lock:
-                        self._stopped += 1
-                        done = self._stopped >= self.num_workers
-                    _send_msg(conn, ("ok",))
-                    if done:
-                        self._srv.close()
-                    return
-                else:
-                    _send_msg(conn, ("err", f"unknown op {op!r}"))
+                try:
+                    if self._handle(conn, msg):
+                        return
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:  # reply, don't hang the client
+                    _send_msg(conn, ("err", f"{type(e).__name__}: {e}"))
         except (ConnectionError, OSError):
             return
+
+    def _handle(self, conn, msg):
+        """Serve one request; True means the connection is done."""
+        op = msg[0]
+        if op == "init":
+            _, key, value = msg
+            with self.lock:
+                # first init wins (reference: rank 0 initializes)
+                self.store.setdefault(key, np.array(value, np.float32))
+            _send_msg(conn, ("ok",))
+        elif op == "push":
+            _, key, value = msg
+            with self.lock:
+                if key not in self.store:
+                    _send_msg(conn, ("err", f"key {key!r} not initialized"))
+                    return False
+                # update-on-arrival: no waiting for other workers
+                if self.updater is not None:
+                    self.updater(key, np.asarray(value, np.float32),
+                                 self.store[key])
+                else:
+                    self.store[key] = np.array(value, np.float32)
+            _send_msg(conn, ("ok",))
+        elif op == "pull":
+            _, key = msg
+            with self.lock:
+                if key not in self.store:
+                    _send_msg(conn, ("err", f"key {key!r} not initialized"))
+                    return False
+                _send_msg(conn, ("ok", self.store[key].copy()))
+        elif op == "set_optimizer":
+            _, blob = msg
+            from .optimizer import get_updater
+
+            opt = pickle.loads(blob)
+            with self.lock:
+                self.updater = wrap_np_updater(get_updater(opt))
+            _send_msg(conn, ("ok",))
+        elif op == "barrier":
+            with self.cv:
+                my_round = self._barrier_round
+                self._barrier_count += 1
+                if self._barrier_count == self.num_workers:
+                    self._barrier_count = 0
+                    self._barrier_round += 1
+                    self.cv.notify_all()
+                else:
+                    self.cv.wait_for(
+                        lambda: self._barrier_round > my_round)
+            _send_msg(conn, ("ok",))
+        elif op == "stop":
+            with self.lock:
+                self._stopped += 1
+                done = self._stopped >= self.num_workers
+            _send_msg(conn, ("ok",))
+            if done:
+                self._srv.close()
+            return True
+        else:
+            _send_msg(conn, ("err", f"unknown op {op!r}"))
+        return False
 
 
 class AsyncKVStore(KVStore):

@@ -41,7 +41,11 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) and not _build():
+        # Always invoke make: its mxtpu_native.cc dependency makes a fresh
+        # .so a no-op, and a stale .so (built before an ABI change, e.g. the
+        # nhwc/out_u8 pipeline args) would otherwise be loaded silently and
+        # corrupt batches.
+        if not _build() and not os.path.exists(_SO):
             return None
         try:
             lib = ctypes.CDLL(_SO)

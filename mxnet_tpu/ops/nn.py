@@ -24,12 +24,14 @@ TPU-native design notes:
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..base import MXNetError
-from .registry import OpProp, REQUIRED, TupleParam, register_op
+from .registry import OpProp, Range, REQUIRED, TupleParam, register_op
 
 
 def _pair(v):
@@ -45,7 +47,7 @@ class FullyConnectedOp(OpProp):
     the MXU (preferred_element_type)."""
 
     params = {
-        "num_hidden": (int, REQUIRED, "number of output units"),
+        "num_hidden": (Range(int, lo=1), REQUIRED, "number of output units"),
         "no_bias": (bool, False, "omit the bias term"),
     }
 
@@ -84,8 +86,8 @@ class ConvolutionOp(OpProp):
         "stride": (TupleParam(2), (1, 1), "stride (h, w)"),
         "pad": (TupleParam(2), (0, 0), "zero-padding (h, w)"),
         "dilate": (TupleParam(2), (1, 1), "dilation (h, w) (extension)"),
-        "num_filter": (int, REQUIRED, "number of output channels"),
-        "num_group": (int, 1, "grouped-convolution group count"),
+        "num_filter": (Range(int, lo=1), REQUIRED, "number of output channels"),
+        "num_group": (Range(int, lo=1), 1, "grouped-convolution group count"),
         "no_bias": (bool, False, "omit the bias term"),
         "workspace": (int, 512, "accepted for parity; XLA manages scratch"),
         "layout": (("NCHW", "NHWC"), "NCHW", "activation layout (NHWC = TPU fast path)"),
@@ -122,17 +124,33 @@ class ConvolutionOp(OpProp):
     def fwd(self, ins, aux, is_train, rng):
         x = ins[0]
         w = ins[1].astype(x.dtype)
-        # no preferred_element_type: its transpose rule mixes dtypes under
-        # bf16 autodiff; TPU convs accumulate f32 for bf16 inputs regardless
-        y = lax.conv_general_dilated(
-            x,
-            w,
-            window_strides=self.stride,
-            padding=[(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])],
-            rhs_dilation=self.dilate,
-            dimension_numbers=(self.layout, "OIHW", self.layout),
-            feature_group_count=self.num_group,
-        )
+        if (self.kernel == (1, 1) and self.pad == (0, 0)
+                and self.dilate == (1, 1) and self.num_group == 1
+                and self.layout == "NHWC"):
+            # Pointwise convs (over half of ResNet-scale conv count) lower as
+            # a plain channel matmul on the MXU. Routing them through
+            # conv_general_dilated lets XLA pick degenerate conv algorithms —
+            # observed: the stage-1 1x1x64x64 conv compiled to a 56x56-window
+            # convolution with pad=55 (activation as the kernel), ~80 GFLOP
+            # of multiply-by-zero per image, 6x the whole model's real work.
+            # dot_general is unambiguous; stride is a slice before the GEMM.
+            sh, sw = self.stride
+            if (sh, sw) != (1, 1):
+                x = x[:, ::sh, ::sw, :]
+            y = lax.dot_general(x, w[:, :, 0, 0],
+                                (((3,), (1,)), ((), ())))
+        else:
+            # no preferred_element_type: its transpose rule mixes dtypes under
+            # bf16 autodiff; TPU convs accumulate f32 for bf16 inputs anyway
+            y = lax.conv_general_dilated(
+                x,
+                w,
+                window_strides=self.stride,
+                padding=[(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])],
+                rhs_dilation=self.dilate,
+                dimension_numbers=(self.layout, "OIHW", self.layout),
+                feature_group_count=self.num_group,
+            )
         if not self.no_bias:
             bshape = (1, 1, 1, -1) if self.layout == "NHWC" else (1, -1, 1, 1)
             y = y + ins[2].astype(x.dtype).reshape(bshape)
@@ -149,8 +167,8 @@ class DeconvolutionOp(OpProp):
         "kernel": (TupleParam(2), REQUIRED, "kernel (h, w)"),
         "stride": (TupleParam(2), (1, 1), "stride (h, w)"),
         "pad": (TupleParam(2), (0, 0), "padding (h, w)"),
-        "num_filter": (int, REQUIRED, "number of output channels"),
-        "num_group": (int, 1, "group count"),
+        "num_filter": (Range(int, lo=1), REQUIRED, "number of output channels"),
+        "num_group": (Range(int, lo=1), 1, "group count"),
         "no_bias": (bool, True, "omit the bias term"),
         "workspace": (int, 512, "accepted for parity"),
         "layout": (("NCHW", "NHWC"), "NCHW", "activation layout (NHWC = TPU fast path)"),
@@ -342,7 +360,7 @@ class DropoutOp(OpProp):
     """Inverted dropout (reference: dropout-inl.h — scales by 1/keep at train
     time, identity at eval)."""
 
-    params = {"p": (float, 0.5, "fraction of units to drop")}
+    params = {"p": (Range(float, lo=0.0, hi=1.0), 0.5, "fraction of units to drop")}
     need_rng = True
 
     def fwd(self, ins, aux, is_train, rng):
@@ -352,6 +370,103 @@ class DropoutOp(OpProp):
         keep = 1.0 - self.p
         mask = jax.random.bernoulli(rng, keep, x.shape)
         return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)], []
+
+
+def _bn_reduce_axes(ndim, ch):
+    return tuple(i for i in range(ndim) if i != ch)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bn_act_train(x, g, b, eps, ch, relu):
+    """Fused training-mode batch norm (optionally + ReLU) with a
+    hand-written VJP.
+
+    Why custom: under autodiff the naive formulation saves full-size f32
+    intermediates (the upcast input, the centered product) as residuals —
+    at ResNet-50 b256 that is ~10 GB of extra HBM traffic per step and
+    pushes XLA into rematerialization. Here the residuals are exactly
+    (x, g, b, mean, inv): the bf16 input (already live as the conv output)
+    plus per-channel f32 vectors. Stats reduce in f32; the normalize and
+    the dx elementwise run in the activation dtype with f32 per-channel
+    scalars — the standard TPU fused-BN recipe.
+
+    With ``relu`` (the executor's BatchNorm -> Activation(relu) fusion,
+    executor.py), the ReLU mask is *recomputed* from the saved conv output
+    in the backward (the pre-relu activation is per-channel affine in x,
+    recomputable in-register), so the BN output is never materialized as a
+    residual — one full-size HBM write + read saved per conv layer on a
+    bandwidth-bound step.
+    """
+    return _bn_act_fwd(x, g, b, eps, ch, relu)[0]
+
+
+def _bn_stats(x, eps, ch):
+    # NOTE on the stats reductions: on the profiled v5e these VPU channel
+    # reductions are the single largest step cost (~0.5 ms each). Ones-matmul
+    # (MXU) and optimization_barrier reformulations were tried and measured
+    # SLOWER or rewritten back to reduces by XLA (vector dots strength-reduce
+    # to reduces; tall-skinny dots lower to degenerate convolutions); the
+    # plain sibling-sum form below is the fastest found.
+    axes = _bn_reduce_axes(x.ndim, ch)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    xf = x.astype(jnp.float32)
+    # one-pass sibling reductions: a single read of x
+    s1 = jnp.sum(xf, axis=axes)
+    s2 = jnp.sum(jnp.square(xf), axis=axes)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+    inv = lax.rsqrt(var + eps)
+    return mean, var, inv, n
+
+
+def _bn_affine(x, g, b, mean, inv, ch):
+    """y = x·scale + shift with per-channel f32 scalars, applied in x.dtype.
+    Shared by forward and backward so the mask recompute is bit-identical."""
+    bshape = tuple(-1 if i == ch else 1 for i in range(x.ndim))
+    scale = g * inv
+    shift = b - mean * scale
+    return x * scale.reshape(bshape).astype(x.dtype) + \
+        shift.reshape(bshape).astype(x.dtype)
+
+
+def _bn_act_fwd(x, g, b, eps, ch, relu):
+    mean, var, inv, _ = _bn_stats(x, eps, ch)
+    y = _bn_affine(x, g, b, mean, inv, ch)
+    if relu:
+        y = jnp.maximum(y, 0)
+    return (y, mean, var), (x, g, b, mean, inv)
+
+
+def _bn_act_bwd(eps, ch, relu, res, cts):
+    x, g, b, mean, inv = res
+    dy = cts[0]  # mean/var outputs feed stop_gradient'd aux: cotangents zero
+    axes = _bn_reduce_axes(x.ndim, ch)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    bshape = tuple(-1 if i == ch else 1 for i in range(x.ndim))
+    mean_b = mean.reshape(bshape)
+    inv_b = inv.reshape(bshape)
+    if relu:
+        # recompute the pre-relu activation with the forward's exact
+        # expression and dtype, so the mask is bit-identical
+        dy = jnp.where(_bn_affine(x, g, b, mean, inv, ch) > 0, dy,
+                       jnp.zeros((), dy.dtype))
+    xhat = (x - mean_b.astype(x.dtype)) * inv_b.astype(x.dtype)
+    dyf = dy.astype(jnp.float32)
+    xhat_f = (x.astype(jnp.float32) - mean_b) * inv_b
+    dbeta = jnp.sum(dyf, axis=axes)
+    dgamma = jnp.sum(dyf * xhat_f, axis=axes)
+    # dx = g·inv · (dy - Σdy/n - x̂·Σ(dy·x̂)/n), elementwise in dy.dtype
+    k = (g * inv).reshape(bshape).astype(dy.dtype)
+    dx = k * (dy - (dbeta / n).reshape(bshape).astype(dy.dtype)
+              - xhat * (dgamma / n).reshape(bshape).astype(dy.dtype))
+    return dx.astype(x.dtype), dgamma, dbeta
+
+
+_bn_act_train.defvjp(_bn_act_fwd, _bn_act_bwd)
 
 
 @register_op("BatchNorm")
@@ -365,8 +480,8 @@ class BatchNormOp(OpProp):
     fully-connected activations)."""
 
     params = {
-        "eps": (float, 1e-3, "numerical stability constant"),
-        "momentum": (float, 0.9, "running-average decay"),
+        "eps": (Range(float, lo=0.0), 1e-3, "numerical stability constant"),
+        "momentum": (Range(float, lo=0.0, hi=1.0), 0.9, "running-average decay"),
         "fix_gamma": (bool, False, "freeze gamma at 1"),
         "axis": (int, 1, "channel axis (1 for NCHW, -1/3 for NHWC)"),
     }
@@ -388,41 +503,29 @@ class BatchNormOp(OpProp):
         return [d, c, c], [d], [c, c]
 
     def fwd(self, ins, aux, is_train, rng):
+        return self._fwd_impl(ins, aux, is_train, relu=False)
+
+    def fwd_fused_relu(self, ins, aux, is_train, rng):
+        """BatchNorm+ReLU in one op — target of the executor's fusion pass
+        (executor.py) for BatchNorm -> Activation(relu) chains."""
+        return self._fwd_impl(ins, aux, is_train, relu=True)
+
+    def _fwd_impl(self, ins, aux, is_train, relu):
         x, gamma, beta = ins
         moving_mean, moving_var = aux
-        if x.ndim == 2:
-            axes, bshape = (0,), (1, -1)
-        else:
-            ch = self.axis % x.ndim
-            axes = tuple(i for i in range(x.ndim) if i != ch)
-            bshape = tuple(-1 if i == ch else 1 for i in range(x.ndim))
+        ch = 1 if x.ndim == 2 else self.axis % x.ndim
         g = (jnp.ones_like(gamma) if self.fix_gamma else gamma).astype(jnp.float32)
         b = beta.astype(jnp.float32)
         if is_train:
-            # One-pass stats: sibling sum / sum-of-squares reductions fuse
-            # into a single read of x. (jnp.var's two-pass E[(x-m)²] would
-            # read every activation a second time — a full extra HBM pass per
-            # BN layer, which at ResNet scale is ~10% of step time.)
-            n = 1
-            for a in axes:
-                n *= x.shape[a]
-            s1 = jnp.sum(x.astype(jnp.float32), axis=axes)
-            s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axes)
-            mean = s1 / n
-            var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+            y, mean, var = _bn_act_train(x, g, b, self.eps, ch, relu)
             new_mean = self.momentum * moving_mean + (1 - self.momentum) * mean
             new_var = self.momentum * moving_var + (1 - self.momentum) * var
-            new_aux = [new_mean, new_var]
-        else:
-            mean, var = moving_mean, moving_var
-            new_aux = [moving_mean, moving_var]
-        inv = lax.rsqrt(var + self.eps)
-        # y = x·scale + shift with per-channel f32 scalars; the fused
-        # elementwise kernel reads/writes bf16, intermediates stay on-core
-        scale = inv * g
-        shift = b - mean * scale
-        y = x.astype(jnp.float32) * scale.reshape(bshape) + shift.reshape(bshape)
-        return [y.astype(x.dtype)], [lax.stop_gradient(a) for a in new_aux]
+            return [y], [lax.stop_gradient(new_mean), lax.stop_gradient(new_var)]
+        inv = lax.rsqrt(moving_var + self.eps)
+        y = _bn_affine(x, g, b, moving_mean, inv, ch)
+        if relu:
+            y = jnp.maximum(y, 0)
+        return [y], [moving_mean, moving_var]
 
 
 @register_op("LRN")
@@ -431,7 +534,7 @@ class LRNOp(OpProp):
     y = x / (knorm + alpha/n * sum_{window} x²)^beta."""
 
     params = {
-        "nsize": (int, REQUIRED, "normalization window (channels)"),
+        "nsize": (Range(int, lo=1), REQUIRED, "normalization window (channels)"),
         "alpha": (float, 1e-4, "scale"),
         "beta": (float, 0.75, "exponent"),
         "knorm": (float, 2.0, "additive constant"),

@@ -22,44 +22,12 @@ normalized at construction, and docstrings are auto-generated from it
 
 from __future__ import annotations
 
-import ast
-
 from ..base import MXNetError, Registry
+from ..params import REQUIRED, Range, TupleParam, apply_params, autodoc
 
-__all__ = ["OpProp", "OPS", "register_op", "REQUIRED", "TupleParam"]
+__all__ = ["OpProp", "OPS", "register_op", "REQUIRED", "Range", "TupleParam"]
 
 OPS = Registry("operator")
-
-REQUIRED = object()
-
-
-class TupleParam:
-    """Marker type for int-tuple params like kernel/stride/pad ('(2,2)' ok)."""
-
-    def __init__(self, length=None):
-        self.length = length
-
-    def __call__(self, value):
-        if isinstance(value, str):
-            value = ast.literal_eval(value)
-        if isinstance(value, int):
-            value = (value,) * (self.length or 1)
-        value = tuple(int(v) for v in value)
-        if self.length is not None and len(value) != self.length:
-            raise MXNetError(f"expected tuple of length {self.length}, got {value}")
-        return value
-
-
-def _coerce(typ, value):
-    if typ is bool and isinstance(value, str):
-        return value.lower() in ("1", "true", "yes", "on")
-    if isinstance(typ, TupleParam):
-        return typ(value)
-    if isinstance(typ, tuple):  # enum of strings
-        if value not in typ:
-            raise MXNetError(f"expected one of {typ}, got {value!r}")
-        return value
-    return typ(value)
 
 
 class OpProp:
@@ -81,21 +49,7 @@ class OpProp:
     is_loss = False
 
     def __init__(self, **kwargs):
-        self.attr = {}
-        spec = type(self).params
-        for key, value in kwargs.items():
-            if key not in spec:
-                raise MXNetError(
-                    f"{type(self).__name__}: unknown parameter {key!r}; "
-                    f"accepts {sorted(spec)}"
-                )
-            typ = spec[key][0]
-            self.attr[key] = _coerce(typ, value)
-        for key, (typ, default, _doc) in spec.items():
-            if key not in self.attr:
-                if default is REQUIRED:
-                    raise MXNetError(f"{type(self).__name__}: parameter {key!r} is required")
-                self.attr[key] = default
+        self.attr = apply_params(type(self).__name__, type(self).params, kwargs)
 
     def __getattr__(self, item):
         try:
@@ -162,21 +116,7 @@ def register_op(op_name, aliases=()):
         OPS.register(op_name)(cls)
         for alias in aliases:
             OPS._entries[alias.lower()] = cls
-        _autodoc(cls)
+        autodoc(cls)
         return cls
 
     return _reg
-
-
-def _autodoc(cls):
-    if not cls.params:
-        return
-    lines = [cls.__doc__ or "", "", "Parameters", "----------"]
-    for key, (typ, default, doc) in cls.params.items():
-        tname = getattr(typ, "__name__", None) or (
-            f"one of {typ}" if isinstance(typ, tuple) else "tuple of int"
-        )
-        req = "required" if default is REQUIRED else f"default={default!r}"
-        lines.append(f"{key} : {tname}, {req}")
-        lines.append(f"    {doc}")
-    cls.__doc__ = "\n".join(lines)

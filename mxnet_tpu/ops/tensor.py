@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
-from .registry import OpProp, REQUIRED, register_op
+from .registry import OpProp, Range, REQUIRED, register_op
 
 
 class _BinaryOp(OpProp):
@@ -65,7 +65,7 @@ class ElementWiseSumOp(OpProp):
     """Sum of N inputs (reference: elementwise_sum-inl.h; also the node type
     the reference's autodiff inserts for gradient aggregation)."""
 
-    params = {"num_args": (int, REQUIRED, "number of inputs")}
+    params = {"num_args": (Range(int, lo=1), REQUIRED, "number of inputs")}
 
     def list_arguments(self):
         return [f"arg{i}" for i in range(self.num_args)]
@@ -88,7 +88,7 @@ class ConcatOp(OpProp):
     """Concatenate along ``dim`` (reference: concat-inl.h, default channel dim 1)."""
 
     params = {
-        "num_args": (int, REQUIRED, "number of inputs"),
+        "num_args": (Range(int, lo=1), REQUIRED, "number of inputs"),
         "dim": (int, 1, "dimension to concatenate along"),
     }
 
@@ -126,7 +126,7 @@ class SliceChannelOp(OpProp):
     slice_channel-inl.h; used to split LSTM gates)."""
 
     params = {
-        "num_outputs": (int, REQUIRED, "number of output splits"),
+        "num_outputs": (Range(int, lo=1), REQUIRED, "number of output splits"),
         "axis": (int, 1, "axis to split along (extension; reference fixes 1)"),
         "squeeze_axis": (bool, False, "remove the split axis if it becomes 1"),
     }
@@ -313,8 +313,8 @@ class EmbeddingOp(OpProp):
     ``jnp.take`` gather."""
 
     params = {
-        "input_dim": (int, REQUIRED, "vocabulary size"),
-        "output_dim": (int, REQUIRED, "embedding dimension"),
+        "input_dim": (Range(int, lo=1), REQUIRED, "vocabulary size"),
+        "output_dim": (Range(int, lo=1), REQUIRED, "embedding dimension"),
     }
 
     def list_arguments(self):

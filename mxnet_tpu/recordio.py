@@ -127,6 +127,22 @@ class MXIndexedRecordIO(MXRecordIO):
         return self.read()
 
 
+def read_record_at(f, offset: int) -> bytes:
+    """Read one record payload from an open binary file at ``offset``
+    (an entry of :func:`scan_offsets`). CRC-checked like sequential reads."""
+    f.seek(offset)
+    header = f.read(_HEADER.size)
+    if len(header) < _HEADER.size:
+        raise MXNetError("truncated record header")
+    magic, crc, length = _HEADER.unpack(header)
+    if magic != RECORD_MAGIC:
+        raise MXNetError("bad record magic at offset %d" % offset)
+    buf = f.read(length)
+    if len(buf) < length or zlib.crc32(buf) != crc:
+        raise MXNetError("corrupt record at offset %d" % offset)
+    return buf
+
+
 def scan_offsets(uri: str) -> list[int]:
     """Record offsets by header-seeking (no payload reads, no crc check) —
     constructor-time scan of large shards stays I/O-light. The native library

@@ -83,6 +83,48 @@ def test_batchnorm_grad():
     _check_grads(sym, {"data": (4, 3, 5, 5)}, atol=5e-2)
 
 
+def test_batchnorm_relu_fused_grad():
+    """The executor fuses BatchNorm -> Activation(relu); its hand-written
+    VJP (recomputed relu mask) must match finite differences."""
+    bn = S.BatchNorm(data=S.Variable("data"), name="bn")
+    sym = S.Activation(data=bn, act_type="relu", name="relu")
+    _check_grads(sym, {"data": (4, 3, 5, 5)}, atol=5e-2)
+
+
+def test_batchnorm_relu_fused_matches_unfused(monkeypatch):
+    """Fused vs MXNET_TPU_FUSE=0 paths agree on outputs, grads, and aux."""
+    bn = S.BatchNorm(data=S.Variable("data"), name="bn")
+    sym = S.Activation(data=bn, act_type="relu", name="relu")
+    rng = np.random.RandomState(1)
+    vals = {n: jnp.asarray(rng.uniform(-1, 1, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(),
+                            sym.infer_shape(data=(4, 3, 5, 5))[0])}
+    aux = {"bn_moving_mean": jnp.zeros(3), "bn_moving_var": jnp.ones(3)}
+    key = jax.random.PRNGKey(0)
+
+    def run():
+        fn = _build_graph_fn(sym, is_train=True)
+
+        def loss(v):
+            outs, new_aux = fn(v, aux, key)
+            return jnp.sum(outs[0] ** 2), (outs[0], new_aux)
+
+        (l, (out, new_aux)), grads = jax.value_and_grad(
+            loss, has_aux=True)(vals)
+        return l, out, new_aux, grads
+
+    monkeypatch.setenv("MXNET_TPU_FUSE", "0")
+    l0, out0, aux0, g0 = run()
+    monkeypatch.setenv("MXNET_TPU_FUSE", "1")
+    l1, out1, aux1, g1 = run()
+    np.testing.assert_allclose(out0, out1, atol=1e-6)
+    np.testing.assert_allclose(l0, l1, atol=1e-5)
+    for k in aux0:
+        np.testing.assert_allclose(aux0[k], aux1[k], atol=1e-6)
+    for k in g0:
+        np.testing.assert_allclose(g0[k], g1[k], atol=1e-5, err_msg=k)
+
+
 def test_embedding_grad():
     emb = S.Embedding(data=S.Variable("data"), input_dim=7, output_dim=4,
                       name="emb")

@@ -1,0 +1,87 @@
+"""Typed parameter system tests (dmlc::Parameter parity:
+include/mxnet/operator.h:456-459 declares op params through reflection;
+c_api.cc:378-391 exports generated docs; dmlc::ParamError names the field).
+"""
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.params import REQUIRED, Range, TupleParam, apply_params
+
+
+def test_range_validator():
+    r = Range(int, lo=1, hi=8)
+    assert r("4") == 4
+    with pytest.raises(MXNetError):
+        r(0)
+    with pytest.raises(MXNetError):
+        r(9)
+    assert "int" in r.__name__ and ">= 1" in r.__name__
+
+
+def test_apply_params_errors_name_owner_and_field():
+    spec = {"n": (Range(int, lo=1), REQUIRED, "count")}
+    with pytest.raises(MXNetError, match="MyOp.*'n'"):
+        apply_params("MyOp", spec, {"n": 0})
+    with pytest.raises(MXNetError, match="MyOp.*'bogus'"):
+        apply_params("MyOp", spec, {"bogus": 1})
+    with pytest.raises(MXNetError, match="MyOp.*'n' is required"):
+        apply_params("MyOp", spec, {})
+
+
+def test_op_params_range_checked():
+    with pytest.raises(MXNetError, match="num_filter"):
+        sym.Convolution(data=sym.Variable("d"), kernel=(3, 3), num_filter=0)
+    with pytest.raises(MXNetError, match="num_hidden"):
+        sym.FullyConnected(data=sym.Variable("d"), num_hidden=-1)
+    with pytest.raises(MXNetError, match="'p'"):
+        sym.Dropout(data=sym.Variable("d"), p=1.5)
+
+
+def test_op_docstrings_generated():
+    doc = mx.sym.Convolution.__doc__
+    assert "Parameters" in doc
+    assert "num_filter : int (>= 1), required" in doc
+    assert "kernel : tuple of int, required" in doc
+    doc = mx.sym.BatchNorm.__doc__
+    assert "momentum : float (>= 0.0, <= 1.0), default=0.9" in doc
+
+
+def test_iterator_params_validated(tmp_path):
+    with pytest.raises(MXNetError, match="ImageRecordIter.*'batch_size'"):
+        mio.ImageRecordIter(path_imgrec="x.rec", data_shape=(3, 8, 8),
+                            batch_size=0)
+    with pytest.raises(MXNetError, match="ImageRecordIter.*'bogus'"):
+        mio.ImageRecordIter(path_imgrec="x.rec", data_shape=(3, 8, 8),
+                            batch_size=2, bogus=1)
+    with pytest.raises(MXNetError, match="'path_imgrec' is required"):
+        mio.ImageRecordIter(data_shape=(3, 8, 8), batch_size=2)
+    with pytest.raises(MXNetError, match="MNISTIter.*'num_parts'"):
+        mio.MNISTIter(image="a", label="b", num_parts=0)
+
+
+def test_iterator_docstrings_generated():
+    doc = mio.ImageRecordIter.__doc__
+    assert "Parameters" in doc
+    assert "batch_size : int (>= 1), required" in doc
+    assert "output_dtype : one of ('float32', 'uint8')" in doc
+    assert "Parameters" in mio.MNISTIter.__doc__
+    assert "Parameters" in mio.CSVIter.__doc__
+
+
+def test_string_coercion_like_dmlc():
+    """dmlc parses stringly-typed configs; '(2,2)' / 'true' / '0.5' all work."""
+    op = sym.Convolution(data=sym.Variable("d"), kernel="(3,3)",
+                         num_filter="8", no_bias="true")
+    g = op.get_internals()
+    assert g is not None
+    it_params = apply_params(
+        "ImageRecordIter", mio.ImageRecordIter.params,
+        {"path_imgrec": "x", "data_shape": "(3,8,8)", "batch_size": "4",
+         "rand_mirror": "TRUE"})
+    assert it_params["data_shape"] == (3, 8, 8)
+    assert it_params["batch_size"] == 4
+    assert it_params["rand_mirror"] is True

@@ -1,6 +1,8 @@
 """RecordIO + ImageRecordIter tests (reference: python/mxnet/recordio.py use
 and tests/python/unittest/test_io.py Cifar10Rec; data is synthesized)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -84,6 +86,42 @@ def test_image_record_iter(tmp_path):
     np.testing.assert_allclose(
         batches[1].data[0].asnumpy(), again[1].data[0].asnumpy()
     )
+
+
+def test_image_record_iter_mean_compute_and_cache(tmp_path):
+    """Cold path computes the dataset mean at data_shape and caches it to
+    disk; warm path loads the cached file (reference: iter_normalize.h
+    compute-then-save on first pass)."""
+    path, _ = _make_imgrec(tmp_path, n=12, size=32)
+    mean_path = str(tmp_path / "mean.bin")
+    assert not os.path.exists(mean_path)
+    it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                             batch_size=4, shuffle=False, mean_img=mean_path)
+    assert os.path.exists(mean_path)  # cold path wrote the cache
+    from mxnet_tpu.ndarray import load as nd_load
+
+    mean = nd_load(mean_path)["mean_img"].asnumpy()
+    assert mean.shape == (3, 32, 32)
+    # verify it really is the dataset mean (no resize/crop at matching size)
+    r = rio.MXRecordIO(path, "r")
+    imgs = []
+    while True:
+        raw = r.read()
+        if raw is None:
+            break
+        imgs.append(rio.unpack_img(raw)[1].astype(np.float64))
+    r.close()
+    expect = np.stack(imgs).mean(axis=0).transpose(2, 0, 1)
+    np.testing.assert_allclose(mean, expect, atol=1e-2)
+    # batches are mean-subtracted
+    b = next(iter(it)).data[0].asnumpy()
+    assert abs(b.mean()) < 2.0
+    # warm path: loads (mtime unchanged) and produces identical batches
+    mtime = os.path.getmtime(mean_path)
+    it2 = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                              batch_size=4, shuffle=False, mean_img=mean_path)
+    assert os.path.getmtime(mean_path) == mtime
+    np.testing.assert_allclose(next(iter(it2)).data[0].asnumpy(), b)
 
 
 def test_image_record_iter_augment(tmp_path):

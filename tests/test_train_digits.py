@@ -1,0 +1,79 @@
+"""Real-dataset train-to-accuracy tier (reference:
+tests/python/train/test_mlp.py trains actual MNIST and asserts final
+accuracy). This environment has no network egress, so the real dataset is
+scikit-learn's bundled handwritten digits (1797 genuine 8x8 grayscale digit
+scans, shipped inside the package) — same task family, same protocol:
+train/val split, train to convergence, assert the val accuracy bar.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _digits():
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    X = (d.data / 16.0).astype(np.float32)          # (1797, 64) in [0, 1]
+    y = d.target.astype(np.float32)
+    rng = np.random.RandomState(0)
+    order = rng.permutation(len(y))
+    X, y = X[order], y[order]
+    n_train = 1500
+    return X[:n_train], y[:n_train], X[n_train:], y[n_train:]
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=128)
+    net = sym.Activation(data=net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=64)
+    net = sym.Activation(data=net, name="relu2", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc3", num_hidden=10)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _lenet():
+    data = sym.Variable("data")
+    net = sym.Convolution(data=data, name="c1", kernel=(3, 3), pad=(1, 1),
+                          num_filter=16)
+    net = sym.Activation(data=net, name="a1", act_type="relu")
+    net = sym.Pooling(data=net, name="p1", kernel=(2, 2), stride=(2, 2),
+                      pool_type="max")
+    net = sym.Convolution(data=net, name="c2", kernel=(3, 3), pad=(1, 1),
+                          num_filter=32)
+    net = sym.Activation(data=net, name="a2", act_type="relu")
+    net = sym.Pooling(data=net, name="p2", kernel=(2, 2), stride=(2, 2),
+                      pool_type="max")
+    net = sym.Flatten(data=net, name="flat")
+    net = sym.FullyConnected(data=net, name="fc1", num_hidden=64)
+    net = sym.Activation(data=net, name="a3", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=10)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+@pytest.mark.slow
+def test_mlp_digits_val_accuracy():
+    X, y, Xv, yv = _digits()
+    model = mx.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=40,
+                           learning_rate=0.1, momentum=0.9,
+                           initializer=mx.init.Xavier())
+    model.fit(X, y, batch_size=50)
+    acc = model.score(mx.io.NDArrayIter(Xv, yv, batch_size=50))
+    assert acc >= 0.95, f"MLP val accuracy {acc:.4f} < 0.95"
+
+
+@pytest.mark.slow
+def test_lenet_digits_val_accuracy():
+    X, y, Xv, yv = _digits()
+    X4 = X.reshape(-1, 1, 8, 8)
+    Xv4 = Xv.reshape(-1, 1, 8, 8)
+    model = mx.FeedForward(_lenet(), ctx=mx.cpu(), num_epoch=40,
+                           learning_rate=0.1, momentum=0.9,
+                           initializer=mx.init.Xavier())
+    model.fit(X4, y, batch_size=50)
+    acc = model.score(mx.io.NDArrayIter(Xv4, yv, batch_size=50))
+    assert acc >= 0.95, f"LeNet val accuracy {acc:.4f} < 0.95"
