@@ -22,13 +22,13 @@ mx.pred.create <- function(bundle_path) {
 }
 
 mx.pred.set.input <- function(pred, name, value) {
-  # R arrays are column-major; the runtime wants row-major (C) order, so
-  # transpose by reversing dims, like the reference R binding did.
+  # R arrays are column-major; the runtime wants row-major (C) order with
+  # the LOGICAL dims, so reorder the data (aperm) but send dims as-is.
   dims <- dim(value)
   if (is.null(dims)) dims <- length(value)
   value <- aperm(array(value, dims), rev(seq_along(dims)))
   r <- .C("mxtpu_r_set_input", as.integer(pred), as.character(name),
-          as.double(value), as.integer(rev(dims)), as.integer(length(dims)),
+          as.double(value), as.integer(dims), as.integer(length(dims)),
           status = integer(1))
   if (r$status != 0) stop("mxtpu: ", .mx.last.error())
   invisible(NULL)
@@ -60,4 +60,87 @@ mx.pred.get.output <- function(pred, index = 1) {
 mx.pred.free <- function(pred) {
   .C("mxtpu_r_free", as.integer(pred))
   invisible(NULL)
+}
+
+# ---- NDArray construction layer -------------------------------------------
+# Reference capability: R-package/R/ndarray.R (mx.nd.array / mx.nd.zeros /
+# mx.nd.ones and shape accessors). The runtime here is the native predictor
+# (host arrays), so mxtpu.ndarray is a thin typed wrapper holding data in
+# the framework's row-major (C) order, constructed once instead of
+# transposing on every predictor call.
+
+mx.nd.array <- function(value, dims = NULL) {
+  if (is.null(dims)) dims <- if (is.null(dim(value))) length(value) else dim(value)
+  value <- array(as.double(value), dims)
+  # to row-major once (reference R binding transposed at the C boundary)
+  data <- aperm(value, rev(seq_along(dims)))
+  structure(list(data = as.double(data), shape = as.integer(dims)),
+            class = "mxtpu.ndarray")
+}
+
+mx.nd.zeros <- function(shape) mx.nd.array(array(0, shape), shape)
+
+mx.nd.ones <- function(shape) mx.nd.array(array(1, shape), shape)
+
+mx.nd.shape <- function(nd) nd$shape
+
+# back to a plain column-major R array
+as.array.mxtpu.ndarray <- function(x, ...) {
+  aperm(array(x$data, rev(x$shape)), rev(seq_along(x$shape)))
+}
+
+print.mxtpu.ndarray <- function(x, ...) {
+  cat("mxtpu.ndarray", paste(x$shape, collapse = "x"), "\n")
+  print(as.array(x))
+}
+
+.mx.pred.set.input.nd <- function(pred, name, nd) {
+  # data already row-major in nd$shape order: skip the aperm, send the
+  # logical shape
+  r <- .C("mxtpu_r_set_input", as.integer(pred), as.character(name),
+          nd$data, as.integer(nd$shape), as.integer(length(nd$shape)),
+          status = integer(1))
+  if (r$status != 0) stop("mxtpu: ", .mx.last.error())
+  invisible(NULL)
+}
+
+# ---- batched prediction ----------------------------------------------------
+# Reference capability: R-package/R/model.R predict.MXFeedForwardModel —
+# iterate a dataset in batches through the bound executor. Here: slice the
+# leading dimension, pad the final partial batch (round_batch semantics),
+# run the native predictor per batch, and stack the de-padded outputs.
+
+mx.pred.predict <- function(pred, data, input.name = "data",
+                            batch.size = 32, output.index = 1) {
+  nd <- if (inherits(data, "mxtpu.ndarray")) data else mx.nd.array(data)
+  n <- nd$shape[1]
+  sample.shape <- nd$shape[-1]
+  sample.size <- prod(sample.shape)
+  batch.size <- min(batch.size, n)
+  out <- NULL  # preallocated after the first batch reveals the output dims
+  i <- 1
+  while (i <= n) {
+    take <- min(batch.size, n - i + 1)
+    idx <- ((i - 1) * sample.size + 1):((i + take - 1) * sample.size)
+    chunk <- nd$data[idx]
+    if (take < batch.size) {  # pad the tail batch, drop the pad after
+      chunk <- c(chunk, double((batch.size - take) * sample.size))
+    }
+    bnd <- structure(list(data = chunk,
+                          shape = as.integer(c(batch.size, sample.shape))),
+                     class = "mxtpu.ndarray")
+    .mx.pred.set.input.nd(pred, input.name, bnd)
+    mx.pred.forward(pred)
+    res <- mx.pred.get.output(pred, output.index)
+    rdim <- dim(res)
+    if (is.null(out)) out <- array(0, c(n, rdim[-1]))
+    rows <- rep(list(quote(expr = )), length(rdim))
+    rows[[1]] <- (i - 1) + seq_len(take)
+    keep <- rep(list(quote(expr = )), length(rdim))
+    keep[[1]] <- seq_len(take)
+    res <- do.call(`[`, c(list(res), keep, list(drop = FALSE)))
+    out <- do.call(`[<-`, c(list(out), rows, list(value = res)))
+    i <- i + take
+  }
+  out
 }

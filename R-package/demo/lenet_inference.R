@@ -1,0 +1,37 @@
+# LeNet inference end-to-end from R (reference capability:
+# R-package/vignettes — train in Python, predict from R).
+#
+# Python side (once):
+#   import mxnet_tpu as mx, jax.numpy as jnp
+#   model = mx.FeedForward(mx.models.lenet(), ctx=mx.tpu(), num_epoch=8,
+#                          learning_rate=0.1, momentum=0.9,
+#                          initializer=mx.init.Xavier())
+#   model.fit(X, y, batch_size=32)
+#   from mxnet_tpu.predictor import Predictor
+#   Predictor(model.symbol, model.arg_params,
+#             model.aux_params).export("lenet.mxtpu")
+#
+# R side (this script):
+
+library(mxtpu)
+
+args <- commandArgs(trailingOnly = TRUE)
+bundle <- if (length(args) >= 1) args[[1]] else "lenet.mxtpu"
+
+pred <- mx.pred.create(bundle)
+
+# 10 random 28x28 grayscale digits as an mxtpu.ndarray (NCHW)
+X <- mx.nd.array(array(runif(10 * 1 * 28 * 28), c(10, 1, 28, 28)))
+cat("input: "); print(mx.nd.shape(X))
+
+# batched prediction: slices the leading dim, pads the tail batch,
+# stacks the de-padded softmax outputs
+probs <- mx.pred.predict(pred, X, input.name = "data", batch.size = 4)
+stopifnot(all(dim(probs) == c(10, 10)))
+stopifnot(all(abs(rowSums(probs) - 1) < 1e-4))  # softmax rows sum to 1
+
+classes <- max.col(probs)
+cat("predicted classes:", classes, "\n")
+
+mx.pred.free(pred)
+cat("lenet inference OK\n")

@@ -14,10 +14,23 @@ same-class single-device training throughput".
 Method: fused train step (forward + backward + SGD-momentum update in one
 donated XLA program), NHWC activations (channels on the MXU lane dimension;
 weights stay OIHW for checkpoint parity), bf16 compute / f32 master params,
-one-pass-statistics BatchNorm, synthetic on-device data (the input pipeline
+custom-VJP fused BatchNorm(+add)+ReLU kernels (executor fusion passes),
+1x1 convs as channel matmuls, synthetic on-device data (the input pipeline
 is benchmarked separately; the reference's numbers are likewise decode-bound
 only beyond 3000 img/s, README:5). Warmup 2 steps (compile), then timed
 steps with a hard device sync at the end.
+
+Perf envelope on the round-2 rig (one v5e-class chip via the axon tunnel,
+measured matmul peak ~120-150 TF/s): the 103 ms b256 step profiles as
+~50 ms conv+BN-stats fusions (~60 TF/s effective — ResNet's small-channel
+conv mix) and ~45 ms backward elementwise / optimizer fusions. Alternatives
+measured SLOWER on this backend and reverted (see ops/nn.py notes):
+MXU ones-matmul stats (strength-reduced back to reduces; tall-skinny dots
+lower to degenerate convs), optimization_barrier splits, flat-buffer
+optimizer state, batch 512/1024 (OOM at 1024). A conv-only (no-BN) variant
+of the same stack lowers to a 6x SLOWER program — the conv algorithm
+choices on this backend are volatile, and the shipped formulation is the
+fastest found. ~25x the reference's best same-class published number.
 """
 
 from __future__ import annotations

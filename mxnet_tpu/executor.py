@@ -42,18 +42,26 @@ def _fusion_plan(symbol):
     """Graph-level operator fusion (reference analogue: the graph rewrite
     passes GraphExecutor runs before memory planning, graph_executor.cc).
 
-    Currently one pattern: BatchNorm whose sole consumer is Activation(relu)
-    executes as the fused BN+ReLU kernel (ops/nn.py `_bn_relu_train`) so the
-    BN output is never materialized as an autodiff residual — on a
-    bandwidth-bound ResNet step this is ~10 GB/step of HBM traffic.
+    Two patterns (ops/nn.py kernels):
+      - BatchNorm -> Activation(relu)            => `_bn_act_train(relu=True)`
+      - BatchNorm -> _Plus(bn, z) -> Activation(relu)
+                                                 => `_bn_add_relu_train`
+        (the ResNet bottleneck tail: BN + shortcut add + relu)
+    In both, the fused VJP recomputes the relu mask from already-live
+    residuals so the intermediate activations are never materialized — on a
+    bandwidth-bound ResNet step ~10+ GB/step of HBM traffic.
 
-    Returns (fused_bn_ids, passthrough_act_ids): BN nodes to run fused, and
-    the Activation nodes that become identity. Disabled via MXNET_TPU_FUSE=0.
+    Returns (fused_bn, passthrough, skip_bn, fused_add):
+      fused_bn    : BN node ids to run with fwd_fused_relu
+      passthrough : Activation node ids that become identity
+      skip_bn     : BN node ids deferred into a fused add (not executed)
+      fused_add   : add node id -> (bn_node, z_operand_index)
+    Disabled via MXNET_TPU_FUSE=0.
     """
     from .base import env_int
 
     if not env_int("MXNET_TPU_FUSE", 1):
-        return frozenset(), frozenset()
+        return frozenset(), frozenset(), frozenset(), {}
     nodes = symbol._topo()
     consumers: dict = {}
     for node in nodes:
@@ -62,25 +70,43 @@ def _fusion_plan(symbol):
         for s, k in node.inputs:
             consumers.setdefault((id(s), k), []).append(node)
     head_ids = {(id(n), i) for n, i in symbol._heads}
+
+    def _sole_private_output(node):
+        return len(consumers.get((id(node), 0), [])) == 1 and \
+            (id(node), 0) not in head_ids
+
     fused_bn, passthrough = set(), set()
+    skip_bn, fused_add = set(), {}
     for node in nodes:
         if node.is_variable or node.op.name != "Activation" \
                 or node.op.act_type != "relu":
             continue
         src, k = node.inputs[0]
-        if k != 0 or src.is_variable or src.op.name != "BatchNorm":
+        if k != 0 or src.is_variable:
             continue
-        if len(consumers.get((id(src), 0), [])) == 1 and \
-                (id(src), 0) not in head_ids:
-            fused_bn.add(id(src))
-            passthrough.add(id(node))
-    return frozenset(fused_bn), frozenset(passthrough)
+        if src.op.name == "BatchNorm":
+            if _sole_private_output(src):
+                fused_bn.add(id(src))
+                passthrough.add(id(node))
+        elif src.op.name == "_Plus" and _sole_private_output(src):
+            add_node = src
+            for z_idx in (1, 0):
+                bn, bn_k = add_node.inputs[1 - z_idx]
+                if bn_k == 0 and not bn.is_variable \
+                        and bn.op.name == "BatchNorm" \
+                        and _sole_private_output(bn):
+                    skip_bn.add(id(bn))
+                    fused_add[id(add_node)] = (bn, z_idx)
+                    passthrough.add(id(node))
+                    break
+    return frozenset(fused_bn), frozenset(passthrough), frozenset(skip_bn), \
+        fused_add
 
 
 def _build_graph_fn(symbol, is_train: bool):
     """Compile the symbol DAG into a pure function of (args, aux, rng)."""
     nodes = symbol._topo()
-    fused_bn, passthrough = _fusion_plan(symbol)
+    fused_bn, passthrough, skip_bn, fused_add = _fusion_plan(symbol)
 
     def fn(arg_values: dict, aux_values: dict, rng):
         env = {}
@@ -89,11 +115,27 @@ def _build_graph_fn(symbol, is_train: bool):
             if node.is_variable:
                 env[(id(node), 0)] = arg_values[node.name]
                 continue
+            if id(node) in skip_bn:  # executes inside its fused add below
+                continue
+            if id(node) in passthrough:  # relu folded into the producer
+                src, k = node.inputs[0]
+                env[(id(node), 0)] = env[(id(src), k)]
+                continue
+            if id(node) in fused_add:
+                bn, z_idx = fused_add[id(node)]
+                bn_ins = [env[(id(s), k)] for s, k in bn.inputs]
+                z = env[(id(node.inputs[z_idx][0]), node.inputs[z_idx][1])]
+                aux_names = [f"{bn.name}_{a}"
+                             for a in bn.op.list_auxiliary_states()]
+                aux = [aux_values[a] for a in aux_names]
+                outs, updated = bn.op.fwd_fused_add_relu(
+                    bn_ins + [z], aux, is_train, None)
+                env[(id(node), 0)] = outs[0]
+                for a_name, a_val in zip(aux_names, updated):
+                    new_aux[a_name] = a_val
+                continue
             ins = [env[(src_id, k)] for src_id, k in
                    [(id(s), k) for s, k in node.inputs]]
-            if id(node) in passthrough:  # relu folded into the producer BN
-                env[(id(node), 0)] = ins[0]
-                continue
             aux_names = [f"{node.name}_{a}" for a in node.op.list_auxiliary_states()]
             aux = [aux_values[a] for a in aux_names]
             key = jax.random.fold_in(rng, i) if node.op.need_rng else None

@@ -439,9 +439,8 @@ def _bn_act_fwd(x, g, b, eps, ch, relu):
     return (y, mean, var), (x, g, b, mean, inv)
 
 
-def _bn_act_bwd(eps, ch, relu, res, cts):
-    x, g, b, mean, inv = res
-    dy = cts[0]  # mean/var outputs feed stop_gradient'd aux: cotangents zero
+def _bn_core_bwd(x, g, mean, inv, dy, ch):
+    """Shared BN backward math given the (already masked) cotangent."""
     axes = _bn_reduce_axes(x.ndim, ch)
     n = 1
     for a in axes:
@@ -449,11 +448,6 @@ def _bn_act_bwd(eps, ch, relu, res, cts):
     bshape = tuple(-1 if i == ch else 1 for i in range(x.ndim))
     mean_b = mean.reshape(bshape)
     inv_b = inv.reshape(bshape)
-    if relu:
-        # recompute the pre-relu activation with the forward's exact
-        # expression and dtype, so the mask is bit-identical
-        dy = jnp.where(_bn_affine(x, g, b, mean, inv, ch) > 0, dy,
-                       jnp.zeros((), dy.dtype))
     xhat = (x - mean_b.astype(x.dtype)) * inv_b.astype(x.dtype)
     dyf = dy.astype(jnp.float32)
     xhat_f = (x.astype(jnp.float32) - mean_b) * inv_b
@@ -466,7 +460,47 @@ def _bn_act_bwd(eps, ch, relu, res, cts):
     return dx.astype(x.dtype), dgamma, dbeta
 
 
+def _bn_act_bwd(eps, ch, relu, res, cts):
+    x, g, b, mean, inv = res
+    dy = cts[0]  # mean/var outputs feed stop_gradient'd aux: cotangents zero
+    if relu:
+        # recompute the pre-relu activation with the forward's exact
+        # expression and dtype, so the mask is bit-identical
+        dy = jnp.where(_bn_affine(x, g, b, mean, inv, ch) > 0, dy,
+                       jnp.zeros((), dy.dtype))
+    return _bn_core_bwd(x, g, mean, inv, dy, ch)
+
+
 _bn_act_train.defvjp(_bn_act_fwd, _bn_act_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _bn_add_relu_train(x, g, b, z, eps, ch):
+    """Fused BatchNorm + residual-add + ReLU (training) — the executor's
+    fusion pass routes the bottleneck tail BatchNorm -> (+shortcut) ->
+    Activation(relu) here. Residuals: x (conv output, already live), z (the
+    shortcut, already live as a neighbouring residual), and per-channel
+    stats; the block output is never saved and the mask is recomputed —
+    one block-sized HBM write + read removed per residual block."""
+    return _bn_add_relu_fwd(x, g, b, z, eps, ch)[0]
+
+
+def _bn_add_relu_fwd(x, g, b, z, eps, ch):
+    mean, var, inv, _ = _bn_stats(x, eps, ch)
+    y = jnp.maximum(_bn_affine(x, g, b, mean, inv, ch) + z, 0)
+    return (y, mean, var), (x, g, b, z, mean, inv)
+
+
+def _bn_add_relu_bwd(eps, ch, res, cts):
+    x, g, b, z, mean, inv = res
+    dy = cts[0]
+    pre = _bn_affine(x, g, b, mean, inv, ch) + z  # exact fwd expression
+    dy = jnp.where(pre > 0, dy, jnp.zeros((), dy.dtype))
+    dx, dgamma, dbeta = _bn_core_bwd(x, g, mean, inv, dy, ch)
+    return dx, dgamma, dbeta, dy.astype(z.dtype)
+
+
+_bn_add_relu_train.defvjp(_bn_add_relu_fwd, _bn_add_relu_bwd)
 
 
 @register_op("BatchNorm")
@@ -510,19 +544,30 @@ class BatchNormOp(OpProp):
         (executor.py) for BatchNorm -> Activation(relu) chains."""
         return self._fwd_impl(ins, aux, is_train, relu=True)
 
-    def _fwd_impl(self, ins, aux, is_train, relu):
+    def fwd_fused_add_relu(self, ins, aux, is_train, rng):
+        """BatchNorm + residual add + ReLU — target of the executor's fusion
+        pass for BatchNorm -> _Plus -> Activation(relu) (bottleneck tails).
+        ``ins`` is [x, gamma, beta, z] with z the shortcut operand."""
+        return self._fwd_impl(ins[:3], aux, is_train, relu=True, z=ins[3])
+
+    def _fwd_impl(self, ins, aux, is_train, relu, z=None):
         x, gamma, beta = ins
         moving_mean, moving_var = aux
         ch = 1 if x.ndim == 2 else self.axis % x.ndim
         g = (jnp.ones_like(gamma) if self.fix_gamma else gamma).astype(jnp.float32)
         b = beta.astype(jnp.float32)
         if is_train:
-            y, mean, var = _bn_act_train(x, g, b, self.eps, ch, relu)
+            if z is not None:
+                y, mean, var = _bn_add_relu_train(x, g, b, z, self.eps, ch)
+            else:
+                y, mean, var = _bn_act_train(x, g, b, self.eps, ch, relu)
             new_mean = self.momentum * moving_mean + (1 - self.momentum) * mean
             new_var = self.momentum * moving_var + (1 - self.momentum) * var
             return [y], [lax.stop_gradient(new_mean), lax.stop_gradient(new_var)]
         inv = lax.rsqrt(moving_var + self.eps)
         y = _bn_affine(x, g, b, moving_mean, inv, ch)
+        if z is not None:
+            y = y + z
         if relu:
             y = jnp.maximum(y, 0)
         return [y], [moving_mean, moving_var]

@@ -91,6 +91,52 @@ def test_batchnorm_relu_fused_grad():
     _check_grads(sym, {"data": (4, 3, 5, 5)}, atol=5e-2)
 
 
+def test_batchnorm_add_relu_fused_grad():
+    """Bottleneck-tail pattern BN -> +shortcut -> relu (fused by the
+    executor into one kernel) vs finite differences."""
+    bn = S.BatchNorm(data=S.Variable("data"), name="bn")
+    sc = S.Convolution(data=S.Variable("shortcut"), kernel=(1, 1),
+                       num_filter=3, no_bias=True, name="sc")
+    sym = S.Activation(data=bn + sc, act_type="relu", name="relu")
+    _check_grads(sym, {"data": (4, 3, 5, 5), "shortcut": (4, 3, 5, 5)},
+                 atol=5e-2)
+
+
+def test_batchnorm_add_relu_fused_matches_unfused(monkeypatch):
+    """BN+add+relu fused vs MXNET_TPU_FUSE=0: outputs, grads, aux agree."""
+    bn = S.BatchNorm(data=S.Variable("data"), name="bn")
+    sym = S.Activation(data=bn + S.Variable("z"), act_type="relu",
+                       name="relu")
+    rng = np.random.RandomState(2)
+    shapes = dict(zip(sym.list_arguments(),
+                      sym.infer_shape(data=(4, 3, 5, 5), z=(4, 3, 5, 5))[0]))
+    vals = {n: jnp.asarray(rng.uniform(-1, 1, s).astype(np.float32))
+            for n, s in shapes.items()}
+    aux = {"bn_moving_mean": jnp.zeros(3), "bn_moving_var": jnp.ones(3)}
+    key = jax.random.PRNGKey(0)
+
+    def run():
+        fn = _build_graph_fn(sym, is_train=True)
+
+        def loss(v):
+            outs, new_aux = fn(v, aux, key)
+            return jnp.sum(outs[0] ** 2), (outs[0], new_aux)
+
+        (l, (out, new_aux)), grads = jax.value_and_grad(
+            loss, has_aux=True)(vals)
+        return l, out, new_aux, grads
+
+    monkeypatch.setenv("MXNET_TPU_FUSE", "0")
+    l0, out0, aux0, g0 = run()
+    monkeypatch.setenv("MXNET_TPU_FUSE", "1")
+    l1, out1, aux1, g1 = run()
+    np.testing.assert_allclose(out0, out1, atol=1e-6)
+    for k in aux0:
+        np.testing.assert_allclose(aux0[k], aux1[k], atol=1e-6)
+    for k in g0:
+        np.testing.assert_allclose(g0[k], g1[k], atol=1e-5, err_msg=k)
+
+
 def test_batchnorm_relu_fused_matches_unfused(monkeypatch):
     """Fused vs MXNET_TPU_FUSE=0 paths agree on outputs, grads, and aux."""
     bn = S.BatchNorm(data=S.Variable("data"), name="bn")

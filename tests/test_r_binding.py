@@ -110,3 +110,75 @@ def test_r_shim_bad_bundle(shim, tmp_path):
     msg = ctypes.cast(buf, ctypes.c_char_p)
     shim.mxtpu_r_last_error(ctypes.byref(msg), _int(512))
     assert buf.value  # error message populated
+
+
+def _r_call(shim, pid, fn, *args):
+    status = ctypes.c_int(0)
+    getattr(shim, fn)(ctypes.byref(ctypes.c_int(pid)), *args,
+                      ctypes.byref(status))
+    assert status.value == 0, f"{fn} failed: {status.value}"
+
+
+def test_r_shim_lenet_batched_predict(shim, tmp_path):
+    """Conv-net (LeNet) bundle through the shim, driven exactly the way
+    R's mx.pred.predict does it: batches over the leading dim with a
+    padded final batch, outputs de-padded and stacked — parity vs the
+    Python predictor (reference capability: R-package/R/model.R
+    predict.MXFeedForwardModel)."""
+    x = S.Variable("data")
+    net = S.Convolution(data=x, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                        name="c1")
+    net = S.Activation(data=net, act_type="relu", name="a1")
+    net = S.Pooling(data=net, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="p1")
+    net = S.Flatten(data=net, name="flat")
+    net = S.FullyConnected(data=net, num_hidden=10, name="fc")
+    out = S.SoftmaxOutput(data=net, name="softmax")
+
+    rng = np.random.RandomState(1)
+    params = {
+        "c1_weight": nd.array(rng.randn(8, 1, 3, 3).astype(np.float32) * 0.3),
+        "c1_bias": nd.array(np.zeros(8, np.float32)),
+        "fc_weight": nd.array(rng.randn(10, 8 * 4 * 4).astype(np.float32) * 0.1),
+        "fc_bias": nd.array(np.zeros(10, np.float32)),
+    }
+    pred = Predictor(out, params, {}, input_names=["data"])
+    X = rng.randn(10, 1, 8, 8).astype(np.float32)  # 10 samples, batch 4 -> pad
+    bundle = str(tmp_path / "lenet.mxtpu")
+    pred.export(bundle)
+
+    # expected from the Python predictor, full batch
+    pred.forward(data=X)
+    expected = pred.get_output(0)
+
+    path = ctypes.c_char_p(bundle.encode())
+    pid, status = ctypes.c_int(0), ctypes.c_int(0)
+    shim.mxtpu_r_create(ctypes.byref(path), ctypes.byref(pid),
+                        ctypes.byref(status))
+    assert status.value == 0
+
+    batch, n = 4, len(X)
+    outs = []
+    i = 0
+    while i < n:
+        take = min(batch, n - i)
+        chunk = X[i:i + take]
+        if take < batch:  # pad the tail like mx.pred.predict
+            chunk = np.concatenate(
+                [chunk, np.zeros((batch - take,) + X.shape[1:], X.dtype)])
+        data = chunk.astype(np.float64)
+        name = ctypes.c_char_p(b"data")
+        shape = (ctypes.c_int * 4)(*chunk.shape)
+        _r_call(shim, pid.value, "mxtpu_r_set_input", ctypes.byref(name),
+                data.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), shape,
+                _int(4))
+        _r_call(shim, pid.value, "mxtpu_r_forward")
+        buf = np.zeros(batch * 10, np.float64)
+        _r_call(shim, pid.value, "mxtpu_r_get_output", _int(0),
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                _int(batch * 10))
+        outs.append(buf.reshape(batch, 10)[:take])
+        i += take
+    got = np.concatenate(outs)
+    np.testing.assert_allclose(got, expected, atol=2e-4, rtol=1e-3)
+    shim.mxtpu_r_free(ctypes.byref(ctypes.c_int(pid.value)))
