@@ -30,7 +30,7 @@ probs <- mx.pred.predict(pred, X, input.name = "data", batch.size = 4)
 stopifnot(all(dim(probs) == c(10, 10)))
 stopifnot(all(abs(rowSums(probs) - 1) < 1e-4))  # softmax rows sum to 1
 
-classes <- max.col(probs)
+classes <- max.col(probs) - 1  # 0-based digit labels
 cat("predicted classes:", classes, "\n")
 
 mx.pred.free(pred)

@@ -1,0 +1,49 @@
+"""URI-aware stream IO — the dmlc S3/HDFS layer, the TPU-native way.
+
+Reference counterpart: dmlc-core's StreamFactory behind ``USE_S3`` /
+``USE_HDFS`` build flags (reference make/config.mk:82,90) — RecordIO and
+iterators there accept ``s3://`` / ``hdfs://`` URIs transparently.
+
+Here the pluggable-filesystem layer is fsspec: any ``scheme://`` URI is
+opened through ``fsspec.open`` (s3/gcs/hdfs/http/memory/... depending on
+installed drivers), plain paths and ``file://`` go through the builtin
+``open``. Every framework read path that takes a file path (RecordIO,
+ImageRecordIter offset scans, MNISTIter idx files, CSVIter) routes through
+:func:`open_uri`.
+"""
+
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["open_uri", "is_remote_uri"]
+
+
+def is_remote_uri(uri: str) -> bool:
+    """True for scheme'd URIs that need a filesystem driver (not file://)."""
+    if "://" not in uri:
+        return False
+    return not uri.startswith("file://")
+
+
+def open_uri(uri: str, mode: str = "rb"):
+    """Open a local path or a ``scheme://`` URI for streaming.
+
+    Local paths and ``file://`` use the builtin open; anything else goes
+    through fsspec (errors name the missing driver, e.g. s3fs for s3://).
+    """
+    if not is_remote_uri(uri):
+        path = uri[len("file://"):] if uri.startswith("file://") else uri
+        return open(path, mode)
+    try:
+        import fsspec
+    except ImportError as e:  # pragma: no cover - fsspec is baked in
+        raise MXNetError(
+            f"opening {uri!r} needs fsspec for remote filesystems") from e
+    try:
+        return fsspec.open(uri, mode).open()
+    except ImportError as e:
+        raise MXNetError(
+            f"no filesystem driver for {uri!r}: {e} "
+            "(install the fsspec extra for this scheme, e.g. s3fs/gcsfs)"
+        ) from e

@@ -30,6 +30,7 @@ import numpy as np
 from ..base import MXNetError, env_int
 from ..engine import engine
 from ..ndarray import NDArray, array
+from ..filesystem import is_remote_uri, open_uri
 from ..params import REQUIRED, Range, TupleParam, apply_params, autodoc
 
 __all__ = ["DataBatch", "DataIter", "NDArrayIter", "MNISTIter", "ImageRecordIter",
@@ -160,8 +161,9 @@ class NDArrayIter(DataIter):
 
 
 def _read_idx_file(path):
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rb") as f:
+    # GzipFile does not close a passed fileobj: both levels need closing
+    with open_uri(path, "rb") as raw, \
+            (gzip.open(raw, "rb") if path.endswith(".gz") else raw) as f:
         zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
         if zero != 0:
             raise MXNetError(f"{path}: not an idx file")
@@ -405,6 +407,7 @@ class ImageRecordIter(DataIter):
         self._native_first = None
         use_native = (env_int("MXNET_TPU_NATIVE_IO", 1) and self._mean_is_rgb()
                       and not self._needs_py_augment()
+                      and not is_remote_uri(path_imgrec)
                       and self._records_look_jpeg())
         if use_native:
             try:
@@ -447,7 +450,7 @@ class ImageRecordIter(DataIter):
 
         c, th, tw = self.data_shape
         acc = np.zeros((th, tw, c), np.float64)
-        with open(self._path, "rb") as f:
+        with open_uri(self._path, "rb") as f:
             for off in offsets:
                 raw = rio.read_record_at(f, off)
                 _, img = rio.unpack_img(raw)
@@ -508,7 +511,7 @@ class ImageRecordIter(DataIter):
         idxs = range(n) if n <= sample else \
             [int(i * (n - 1) / (sample - 1)) for i in range(sample)]
         try:
-            with open(self._path, "rb") as f:
+            with open_uri(self._path, "rb") as f:
                 for i in idxs:
                     f.seek(self._offsets[i] + 16)  # past the record header
                     flag = _struct.unpack("<I", f.read(4))[0]
@@ -748,13 +751,14 @@ class CSVIter(DataIter):
         cfg = apply_params(type(self).__name__, type(self).params, kwargs)
         data_csv, data_shape = cfg["data_csv"], cfg["data_shape"]
         label_csv, batch_size = cfg["label_csv"], cfg["batch_size"]
-        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        with open_uri(data_csv, "rb") as f:
+            data = np.loadtxt(f, delimiter=",", dtype=np.float32)
         data = data.reshape((-1,) + tuple(data_shape))
-        label = (
-            np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
-            if label_csv
-            else np.zeros((data.shape[0],), np.float32)
-        )
+        if label_csv:
+            with open_uri(label_csv, "rb") as f:
+                label = np.loadtxt(f, delimiter=",", dtype=np.float32)
+        else:
+            label = np.zeros((data.shape[0],), np.float32)
         self._inner = NDArrayIter(data, label, batch_size=batch_size)
         self.batch_size = batch_size
 

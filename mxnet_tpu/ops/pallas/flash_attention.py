@@ -29,6 +29,19 @@ def _use_interpret():
     return jax.default_backend() != "tpu"
 
 
+def _mxu(x):
+    """Matmul-operand dtype policy: keep the input dtype (bf16 runs the MXU
+    at full rate; upcasting to f32 quarters it — accumulation is f32 via
+    preferred_element_type either way). MXNET_TPU_FLASH_F32=1 restores the
+    f32-operand kernels as an escape hatch for backends whose Mosaic builds
+    mishandle bf16 tiles."""
+    from ...base import env_int
+
+    if env_int("MXNET_TPU_FLASH_F32", 0):
+        return x.astype(jnp.float32)
+    return x
+
+
 def _causal_run(qi, kj, bq, bk):
     """Whether key block kj overlaps the causal window of query block qi."""
     return kj * bk <= qi * bq + bq - 1
@@ -62,9 +75,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # matmul operands per the _mxu policy; products accumulate f32
+        q = _mxu(q_ref[0])
+        k = _mxu(k_ref[0])
+        v = _mxu(v_ref[0])
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -74,12 +88,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
         m_prev = m_scr[:, :1]                    # [bq, 1]
         m_blk = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_blk)
-        p = jnp.exp(s - m_new)                   # [bq, bk]
+        p = jnp.exp(s - m_new)                   # [bq, bk] f32
         p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)          # [bq, 1]
         l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc[:] = acc[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -139,10 +153,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = _mxu(q_ref[0])
+        k = _mxu(k_ref[0])
+        v = _mxu(v_ref[0])
+        do = _mxu(do_ref[0])
         lse = lse_ref[0]                         # [bq, 1]
         delta = delta_ref[0]                     # [bq, 1]
         s = jax.lax.dot_general(
@@ -153,7 +167,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -177,24 +191,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = _mxu(q_ref[0])
+        k = _mxu(k_ref[0])
+        v = _mxu(v_ref[0])
+        do = _mxu(do_ref[0])
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         mask = _block_mask(qi, kj, bq, bk, seq_k, causal)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)        # [bq, bk]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)        # [bq, bk] f32
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale                     # [bq, bk]
+        ds = (p * (dp - delta) * scale).astype(q.dtype)   # [bq, bk]
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)

@@ -42,7 +42,9 @@ class MXRecordIO:
         self.open()
 
     def open(self):
-        self._f = open(self.uri, "rb" if self.flag == "r" else "wb")
+        from .filesystem import open_uri
+
+        self._f = open_uri(self.uri, "rb" if self.flag == "r" else "wb")
 
     def close(self):
         if self._f:
@@ -147,8 +149,10 @@ def scan_offsets(uri: str) -> list[int]:
     """Record offsets by header-seeking (no payload reads, no crc check) —
     constructor-time scan of large shards stays I/O-light. The native library
     exposes the same scan (mxtpu_scan_offsets); this is the fallback."""
+    from .filesystem import open_uri
+
     offsets = []
-    with open(uri, "rb") as f:
+    with open_uri(uri, "rb") as f:
         pos = 0
         while True:
             header = f.read(_HEADER.size)

@@ -1,0 +1,77 @@
+"""URI stream-IO tests (reference capability: dmlc S3/HDFS streams behind
+USE_S3/USE_HDFS, make/config.mk:82,90 — RecordIO and iterators accept
+scheme'd URIs). Exercised here with fsspec's memory:// filesystem so no
+network or credentials are needed; s3://, gs://, hdfs:// route identically
+through fsspec drivers."""
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import io as mio
+from mxnet_tpu import recordio as rio
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.filesystem import is_remote_uri, open_uri
+
+
+def test_is_remote_uri():
+    assert is_remote_uri("s3://bucket/key.rec")
+    assert is_remote_uri("memory://x.rec")
+    assert not is_remote_uri("/tmp/x.rec")
+    assert not is_remote_uri("file:///tmp/x.rec")
+    assert not is_remote_uri("relative/path.rec")
+
+
+def test_open_uri_local_and_file_scheme(tmp_path):
+    p = tmp_path / "a.bin"
+    p.write_bytes(b"hello")
+    with open_uri(str(p)) as f:
+        assert f.read() == b"hello"
+    with open_uri("file://" + str(p)) as f:
+        assert f.read() == b"hello"
+
+
+def test_open_uri_unknown_scheme_errors():
+    with pytest.raises((MXNetError, ValueError)):
+        open_uri("notascheme9://x/y").read()
+
+
+def test_recordio_over_memory_fs():
+    uri = "memory://shards/images.rec"
+    w = rio.MXRecordIO(uri, "w")
+    rng = np.random.RandomState(0)
+    labels = []
+    for i in range(12):
+        img = rng.randint(0, 255, (16, 16, 3), np.uint8)
+        labels.append(float(i % 3))
+        w.write(rio.pack_img(rio.IRHeader(0, labels[-1], i, 0), img,
+                             img_fmt=".png"))
+    w.close()
+
+    # offset scan + sequential read over the remote stream
+    offsets = rio.scan_offsets(uri)
+    assert len(offsets) == 12
+    r = rio.MXRecordIO(uri, "r")
+    h, img = rio.unpack_img(r.read())
+    assert h.label == 0.0 and img.shape == (16, 16, 3)
+    r.close()
+
+    # full iterator pipeline from the remote URI (python decode path;
+    # the native C++ pipeline is gated off for remote URIs)
+    it = mio.ImageRecordIter(path_imgrec=uri, data_shape=(3, 16, 16),
+                             batch_size=4, shuffle=False)
+    assert it._native is None
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), labels[:4])
+
+
+def test_csv_iter_over_memory_fs():
+    import fsspec
+
+    with fsspec.open("memory://csv/data.csv", "w") as f:
+        for i in range(6):
+            f.write(",".join(str(i * 4 + j) for j in range(4)) + "\n")
+    it = mio.CSVIter(data_csv="memory://csv/data.csv", data_shape=(4,),
+                     batch_size=3)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.data[0].asnumpy()[0], [0, 1, 2, 3])

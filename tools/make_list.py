@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Generate .lst files for im2rec (rewrite of the reference tools/make_list.py).
+
+Walks an image directory (recursive mode assigns a label per subdirectory),
+shuffles, and writes ``index \t label \t relpath`` list files — optionally
+split into chunks and train/val partitions:
+
+  python tools/make_list.py <image-root> <prefix> [--recursive]
+      [--exts .jpg .jpeg .png] [--chunks N] [--train-ratio R] [--seed S]
+
+With --chunks N > 1, files are named ``prefix_<i>[_train|_val].lst``; with
+--train-ratio < 1, each chunk splits into ``_train``/``_val``. The output
+format is exactly what tools/im2rec.py consumes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+
+
+def list_image(root, recursive, exts):
+    image_list = []
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            for fname in sorted(files):
+                fpath = os.path.join(path, fname)
+                if os.path.isfile(fpath) and \
+                        os.path.splitext(fname)[1].lower() in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    image_list.append((os.path.relpath(fpath, root), cat[path]))
+        for path in sorted(cat, key=cat.get):
+            print(f"label {cat[path]}: {path}")
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            if os.path.isfile(fpath) and \
+                    os.path.splitext(fname)[1].lower() in exts:
+                image_list.append((fname, 0))
+    return image_list
+
+
+def write_list(path_out, image_list, start=0):
+    with open(path_out, "w") as fout:
+        for i, (path, label) in enumerate(image_list):
+            fout.write(f"{start + i}\t{label}\t{path}\n")
+    print(f"wrote {len(image_list)} entries to {path_out}")
+
+
+def make_list(prefix_out, root, recursive=False, exts=(".jpg", ".jpeg"),
+              num_chunks=1, train_ratio=1.0, seed=0):
+    image_list = list_image(root, recursive, set(e.lower() for e in exts))
+    if not image_list:
+        raise SystemExit(f"no images with extensions {sorted(exts)} under {root}")
+    random.Random(seed).shuffle(image_list)
+    n = len(image_list)
+    chunk_size = (n + num_chunks - 1) // num_chunks
+    for i in range(num_chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        tag = f"_{i}" if num_chunks > 1 else ""
+        if train_ratio < 1:
+            sep = int(len(chunk) * train_ratio)
+            write_list(f"{prefix_out}{tag}_train.lst", chunk[:sep])
+            write_list(f"{prefix_out}{tag}_val.lst", chunk[sep:])
+        else:
+            write_list(f"{prefix_out}{tag}.lst", chunk)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Make image list files for im2rec")
+    ap.add_argument("root", help="folder containing images")
+    ap.add_argument("prefix", help="output list file prefix")
+    ap.add_argument("--exts", nargs="+", default=[".jpg", ".jpeg"],
+                    help="acceptable image extensions")
+    ap.add_argument("--chunks", type=int, default=1, help="number of chunks")
+    ap.add_argument("--recursive", action="store_true",
+                    help="one label per subdirectory")
+    ap.add_argument("--train-ratio", type=float, default=1.0,
+                    help="fraction of each chunk for the _train split")
+    ap.add_argument("--seed", type=int, default=0, help="shuffle seed")
+    args = ap.parse_args()
+    make_list(args.prefix, args.root, recursive=args.recursive,
+              exts=args.exts, num_chunks=args.chunks,
+              train_ratio=args.train_ratio, seed=args.seed)
+
+
+if __name__ == "__main__":
+    main()
