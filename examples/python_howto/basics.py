@@ -1,0 +1,58 @@
+"""Framework walkthrough (rewrite of the reference example/python-howto:
+symbol composition, shape inference, binding, the imperative NDArray layer,
+and saving/loading — each step printed).
+
+Run: python examples/python_howto/basics.py
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+
+
+def main():
+    # --- 1. imperative NDArray ------------------------------------------------
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = nd.ones((2, 3))
+    c = a * 2 + b
+    print("NDArray math:\n", c.asnumpy())
+
+    # --- 2. symbolic composition ---------------------------------------------
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=8)
+    net = sym.Activation(data=net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=3)
+    out = sym.SoftmaxOutput(data=net, name="softmax")
+    print("arguments:", out.list_arguments())
+    print("outputs:", out.list_outputs())
+
+    # --- 3. shape inference ---------------------------------------------------
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(4, 10))
+    print("inferred arg shapes:", dict(zip(out.list_arguments(), arg_shapes)))
+    print("inferred out shapes:", out_shapes)
+
+    # --- 4. bind + forward + backward ----------------------------------------
+    exe = out.simple_bind(ctx=mx.cpu(), data=(4, 10), softmax_label=(4,))
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            nd.array(rng.randn(*arr.shape).astype(np.float32) * 0.1).copyto(arr)
+    x = rng.randn(4, 10).astype(np.float32)
+    y = np.array([0, 1, 2, 0], np.float32)
+    probs = exe.forward(is_train=True, data=x, softmax_label=y)[0]
+    print("softmax row sums:", probs.asnumpy().sum(axis=1))
+    exe.backward()
+    print("dL/d(fc1_weight) shape:", exe.grad_dict["fc1_weight"].shape)
+
+    # --- 5. graph introspection + save/load ----------------------------------
+    print(out.debug_str().splitlines()[0])
+    js = out.tojson()
+    out2 = sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    print("symbol JSON roundtrip ok:", len(js), "bytes")
+
+
+if __name__ == "__main__":
+    main()

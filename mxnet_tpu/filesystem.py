@@ -42,8 +42,8 @@ def open_uri(uri: str, mode: str = "rb"):
             f"opening {uri!r} needs fsspec for remote filesystems") from e
     try:
         return fsspec.open(uri, mode).open()
-    except ImportError as e:
+    except (ImportError, ValueError) as e:  # missing driver / unknown scheme
         raise MXNetError(
-            f"no filesystem driver for {uri!r}: {e} "
+            f"cannot open {uri!r}: {e} "
             "(install the fsspec extra for this scheme, e.g. s3fs/gcsfs)"
         ) from e

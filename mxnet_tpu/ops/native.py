@@ -92,10 +92,24 @@ class NativeOp(OpProp):
         return list(self._op().list_outputs())
 
     def infer_shape(self, in_shapes):
+        # reference protocol (operator.py NumpyOp.infer_shape): the user op
+        # receives the partial list and derives the rest — e.g. a loss head
+        # infers its label shape from the data shape
         known = [tuple(s) if s is not None else None for s in in_shapes]
-        if any(s is None for s in known):
-            raise MXNetError("_Native: all input shapes must be known")
-        ins, outs = self._op().infer_shape(known)
+        if known[0] is None:
+            raise MXNetError("_Native: shape of the first input must be known")
+        try:
+            ins, outs = self._op().infer_shape(known)
+        except MXNetError:
+            raise
+        except Exception as e:  # keep node-name context for user-op bugs
+            raise MXNetError(
+                f"{type(self._op()).__name__}.infer_shape({known}) raised "
+                f"{type(e).__name__}: {e}") from e
+        if any(s is None for s in ins) or any(s is None for s in outs):
+            raise MXNetError(
+                f"{type(self._op()).__name__}.infer_shape left shapes "
+                "unresolved")
         return [tuple(s) for s in ins], [tuple(s) for s in outs], []
 
     def fwd(self, ins, aux, is_train, rng):

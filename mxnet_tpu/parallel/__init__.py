@@ -15,7 +15,10 @@ and XLA collectives over ICI/DCN (psum/all_gather/ppermute/reduce_scatter).
 """
 
 from .mesh import make_mesh, auto_mesh, data_sharding, replicated
-from .data_parallel import shard_batch, replicate_params, allreduce_grads
+from .data_parallel import (allreduce_grads, grad_accum,
+                            host_local_batch_to_global,
+                            make_data_parallel_step, replicate_params,
+                            shard_batch)
 from .tensor_parallel import (column_parallel, row_parallel,
                               transformer_param_specs)
 from .sequence import (ring_attention, ring_flash_attention,

@@ -10,7 +10,9 @@ from __future__ import annotations
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["shard_batch", "replicate_params", "allreduce_grads"]
+__all__ = ["shard_batch", "replicate_params", "allreduce_grads",
+           "grad_accum", "make_data_parallel_step",
+           "host_local_batch_to_global"]
 
 
 def shard_batch(batch, mesh, axis="dp"):
@@ -34,3 +36,78 @@ def allreduce_grads(grads, axis_name="dp", average=True):
     if average:
         return jax.tree_util.tree_map(lambda g: g / n, summed)
     return summed
+
+
+def grad_accum(loss_fn, params, batch, n_micro):
+    """Gradient accumulation over ``n_micro`` microbatches via ``lax.scan``.
+
+    The TPU lever the reference's per-device batch splitting
+    (python/mxnet/model.py _train_multi_device slices) maps to: peak
+    activation memory scales with batch/n_micro while the optimizer sees
+    the full-batch (mean) gradient. ``batch`` is a pytree whose leaves'
+    leading dimension is divisible by ``n_micro``; ``loss_fn(params,
+    microbatch)`` returns a scalar mean loss. Returns (mean_loss,
+    mean_grads). Compiler-friendly: one traced microstep, scanned.
+    """
+    import jax.numpy as jnp
+
+    def reshape(x):
+        return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+
+    micro = jax.tree_util.tree_map(reshape, batch)
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def step(carry, mb):
+        loss_acc, grads_acc = carry
+        loss, grads = grad_fn(params, mb)
+        grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+        return (loss_acc + loss, grads_acc), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (loss_sum, grads_sum), _ = jax.lax.scan(step, (0.0, zeros), micro)
+    mean = lambda t: jax.tree_util.tree_map(lambda x: x / n_micro, t)
+    return loss_sum / n_micro, mean(grads_sum)
+
+
+def make_data_parallel_step(loss_fn, update_fn, mesh, axis="dp",
+                            donate=True, n_micro=1):
+    """Build a jitted data-parallel train step over ``mesh``.
+
+    ``loss_fn(params, batch) -> scalar mean loss``;
+    ``update_fn(params, opt_state, grads) -> (params, opt_state)``.
+    Params/opt state are replicated, the batch is sharded on ``axis``; the
+    SPMD partitioner inserts the gradient all-reduce (the in-jit psum path
+    KVStore 'device' documents as the fast path). With ``n_micro > 1``
+    each shard additionally accumulates over microbatches (grad_accum).
+
+    Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``;
+    feed batches placed with :func:`shard_batch`.
+    """
+    rep = NamedSharding(mesh, P())
+
+    def step(params, opt_state, batch):
+        if n_micro > 1:
+            loss, grads = grad_accum(loss_fn, params, batch, n_micro)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        params, opt_state = update_fn(params, opt_state, grads)
+        return params, opt_state, loss
+
+    return jax.jit(
+        step,
+        out_shardings=(rep, rep, rep),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def host_local_batch_to_global(batch, mesh, axis="dp"):
+    """Multi-host glue: each process's local batch shard becomes one slice
+    of a global batch-sharded array (≙ the reference's per-worker
+    num_parts/part_index iterator split feeding dist_sync). Single-process
+    meshes fall back to :func:`shard_batch`."""
+    if jax.process_count() == 1:
+        return shard_batch(batch, mesh, axis)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(
+        batch, mesh, P(axis))

@@ -1,15 +1,26 @@
 """First-class profiling (SURVEY.md §5: the reference's tracing story is
-thin — engine debug logs + a python Speedometer; here profiling surfaces the
-JAX/XProf trace machinery directly)."""
+engine debug logs + a python Speedometer; here profiling surfaces the
+JAX/XProf trace machinery directly AND digests the captured device trace
+into a per-op time table — the report the reference's users got from
+nvprof, produced framework-side).
+"""
 
 from __future__ import annotations
 
+import collections
 import contextlib
+import glob
+import gzip
+import json
+import os
+import re
+import tempfile
 import time
 
 import jax
 
-__all__ = ["start_trace", "stop_trace", "profile_scope", "Timer"]
+__all__ = ["start_trace", "stop_trace", "profile_scope", "Timer",
+           "OpStat", "trace_op_stats", "profile_step"]
 
 
 def start_trace(log_dir: str):
@@ -39,3 +50,72 @@ class Timer:
         jax.effects_barrier()
         self.elapsed = time.perf_counter() - self.start
         return False
+
+
+class OpStat(collections.namedtuple("OpStat", "name total_us count")):
+    """Aggregated device time for one op (XLA fusion root) across a trace."""
+
+    __slots__ = ()
+
+    def __str__(self):
+        return f"{self.total_us / 1e3:10.3f} ms  x{self.count:<6d} {self.name}"
+
+
+def trace_op_stats(log_dir: str, device_substr: str = "", top: int | None = None):
+    """Parse a captured trace directory into per-op device-time stats.
+
+    Reads the ``*.trace.json.gz`` XProf exports under ``log_dir``, keeps
+    event lanes named "XLA Ops" on device processes (TPU or CPU), strips
+    instruction-id suffixes so repeats of the same fusion aggregate, and
+    returns OpStat rows sorted by total time. This is the op breakdown the
+    profiler UI shows, available programmatically (used to find, e.g., that
+    a ResNet step's time lives in conv+stats fusions — see bench.py notes).
+    """
+    files = sorted(glob.glob(os.path.join(log_dir, "**", "*.trace.json.gz"),
+                             recursive=True))
+    if not files:
+        raise FileNotFoundError(f"no trace.json.gz under {log_dir!r}")
+    by: collections.Counter = collections.Counter()
+    counts: collections.Counter = collections.Counter()
+    for path in files:
+        with gzip.open(path, "rt") as f:
+            data = json.load(f)
+        events = data.get("traceEvents", [])
+        proc_names = {e["pid"]: e["args"].get("name", "")
+                      for e in events
+                      if e.get("ph") == "M" and e.get("name") == "process_name"}
+        lanes = {(e["pid"], e["tid"]): e["args"].get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            pid, tid = e.get("pid"), e.get("tid")
+            if device_substr and device_substr not in proc_names.get(pid, ""):
+                continue
+            if "XLA Ops" not in lanes.get((pid, tid), ""):
+                continue
+            key = re.sub(r"\.\d+", "", e["name"])
+            by[key] += e.get("dur", 0)
+            counts[key] += 1
+    stats = [OpStat(name, us, counts[name]) for name, us in by.most_common()]
+    return stats[:top] if top else stats
+
+
+def profile_step(fn, *args, iters: int = 3, log_dir: str | None = None,
+                 top: int | None = 20):
+    """Trace ``iters`` calls of a (jitted) function and return its op stats.
+
+    Convenience wrapper: warms up once, captures a trace, digests it with
+    :func:`trace_op_stats`. Returns ``(stats, log_dir)``; ``log_dir``
+    defaults to a kept temp dir so the full trace can still be opened in
+    the profiler UI.
+    """
+    out = fn(*args)
+    jax.block_until_ready(out)
+    log_dir = log_dir or tempfile.mkdtemp(prefix="mxtpu_profile_")
+    with jax.profiler.trace(log_dir):
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+    return trace_op_stats(log_dir, top=top), log_dir

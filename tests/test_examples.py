@@ -1,0 +1,29 @@
+"""Runnable-example tier: the custom-op and adversary examples exercise API
+surfaces nothing else covers end-to-end (NumpyOp training loop; input-grad
+bind/backward), mirroring the reference's example-based CI."""
+
+import os
+import runpy
+
+import pytest
+
+_EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(rel):
+    runpy.run_path(os.path.join(_EXAMPLES, rel), run_name="__main__")
+
+
+@pytest.mark.slow
+def test_numpy_softmax_example():
+    _run("numpy_ops/numpy_softmax.py")
+
+
+@pytest.mark.slow
+def test_fgsm_adversary_example():
+    _run("adversary/fgsm.py")
+
+
+@pytest.mark.slow
+def test_python_howto_example():
+    _run("python_howto/basics.py")

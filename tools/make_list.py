@@ -44,10 +44,10 @@ def list_image(root, recursive, exts):
     return image_list
 
 
-def write_list(path_out, image_list, start=0):
+def write_list(path_out, image_list):
     with open(path_out, "w") as fout:
         for i, (path, label) in enumerate(image_list):
-            fout.write(f"{start + i}\t{label}\t{path}\n")
+            fout.write(f"{i}\t{label}\t{path}\n")
     print(f"wrote {len(image_list)} entries to {path_out}")
 
 
@@ -61,6 +61,8 @@ def make_list(prefix_out, root, recursive=False, exts=(".jpg", ".jpeg"),
     chunk_size = (n + num_chunks - 1) // num_chunks
     for i in range(num_chunks):
         chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        if not chunk:  # more chunks than images: skip empty lists
+            continue
         tag = f"_{i}" if num_chunks > 1 else ""
         if train_ratio < 1:
             sep = int(len(chunk) * train_ratio)
