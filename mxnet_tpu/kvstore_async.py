@@ -139,6 +139,28 @@ class _AsyncServer:
                     _send_msg(conn, ("err", f"key {key!r} not initialized"))
                     return False
                 _send_msg(conn, ("ok", self.store[key].copy()))
+        elif op == "push_many":
+            _, kvs = msg  # dict key -> np array: ONE round trip per batch
+            with self.lock:
+                missing = [k for k in kvs if k not in self.store]
+                if missing:
+                    _send_msg(conn, ("err", f"keys not initialized: {missing}"))
+                    return False
+                for k, value in kvs.items():
+                    if self.updater is not None:
+                        self.updater(k, np.asarray(value, np.float32),
+                                     self.store[k])
+                    else:
+                        self.store[k] = np.array(value, np.float32)
+            _send_msg(conn, ("ok",))
+        elif op == "pull_many":
+            _, keys = msg
+            with self.lock:
+                missing = [k for k in keys if k not in self.store]
+                if missing:
+                    _send_msg(conn, ("err", f"keys not initialized: {missing}"))
+                    return False
+                _send_msg(conn, ("ok", {k: self.store[k].copy() for k in keys}))
         elif op == "set_optimizer":
             _, blob = msg
             from .optimizer import get_updater
@@ -263,6 +285,16 @@ class AsyncKVStore(KVStore):
                 outs = [outs]
             for o in outs:
                 NDArray(value).copyto(o)
+
+    def push_many(self, kvs: dict):
+        """Push {key: numpy grad} in ONE round trip (the per-batch trainer
+        path: serialized per-key round trips would dominate step time)."""
+        self._call("push_many",
+                   {k: np.asarray(v, np.float32) for k, v in kvs.items()})
+
+    def pull_many(self, keys) -> dict:
+        """Pull current values for ``keys`` in one round trip."""
+        return self._call("pull_many", list(keys))
 
     def set_updater(self, updater):
         raise MXNetError(

@@ -245,7 +245,7 @@ class FeedForward(BASE_ESTIMATOR):
         return self.symbol
 
     def _build_train_step(self, data_names, label_names, optimizer, mesh,
-                          symbol=None, metric_update=None):
+                          symbol=None, metric_update=None, apply_update=True):
         graph_fn = _build_graph_fn(symbol if symbol is not None else self.symbol,
                                    is_train=True)
         compute_dtype = self.compute_dtype
@@ -266,7 +266,13 @@ class FeedForward(BASE_ESTIMATOR):
                 return loss, (outs, new_aux)
 
             grads, (outs, new_aux) = jax.grad(loss_fn, has_aux=True)(params)
-            new_params, new_opt_state = optimizer.apply(params, grads, opt_state, lr)
+            if apply_update:
+                new_params, new_opt_state = optimizer.apply(
+                    params, grads, opt_state, lr)
+            else:
+                # update-on-kvstore (dist_async): grads come back in the
+                # params slot; the parameter host applies the optimizer
+                new_params, new_opt_state = grads, opt_state
             if metric_update is not None:
                 # fold metric accumulation into the same XLA program — no
                 # per-batch host pull (every pull is a device round-trip) —
@@ -298,6 +304,13 @@ class FeedForward(BASE_ESTIMATOR):
                           mstate)
 
         return run
+
+    def _async_pull_params(self, kv, param_names):
+        """Pull current weights from the dist_async parameter host into
+        self.arg_params (one round trip for all keys)."""
+        pulled = kv.pull_many(param_names)
+        for name in param_names:
+            self.arg_params[name] = NDArray(pulled[name])
 
     def _build_pred_step(self, mesh, symbol=None):
         graph_fn = _build_graph_fn(symbol if symbol is not None else self.symbol,
@@ -368,7 +381,12 @@ class FeedForward(BASE_ESTIMATOR):
 
         kv = _create_kvstore(kvstore, len(self.ctx), self.arg_params)
         num_workers = kv.num_workers if kv is not None else 1
-        mesh = self._make_mesh(dist=kv is not None and "dist" in kv.type)
+        async_kv = kv is not None and kv.type == "dist_async"
+        # dist_async: no BSP collective — each worker trains against the
+        # parameter host at its own pace, so the mesh stays process-local
+        # (reference: update-on-arrival, kvstore_dist_server.h:194-202)
+        mesh = self._make_mesh(
+            dist=kv is not None and "dist" in kv.type and not async_kv)
         if num_workers > 1 and jax.process_count() > 1:
             # rank 0's initialization wins, like kvstore.init from rank 0
             # (reference: kvstore_dist.h:49-60) — otherwise per-process RNGs
@@ -394,10 +412,22 @@ class FeedForward(BASE_ESTIMATOR):
             )
         self._optimizer_obj = optimizer
 
-        # device-resident training state (f32 master params)
+        if async_kv:
+            # update_on_kvstore=True semantics: the optimizer runs on the
+            # parameter host on every push (reference: pickled-optimizer
+            # transport + server-side updater); rank 0's weights initialize
+            # the store, every worker starts from the pulled copy.
+            kv.set_optimizer(optimizer)
+            for name in param_names:
+                kv.init(name, self.arg_params[name])
+            self._async_pull_params(kv, param_names)
+
+        # device-resident training state (f32 master params). dist_async
+        # keeps NO worker-side optimizer state: the server owns it
+        # (update-on-kvstore), so a momentum tree here would be dead HBM.
         params = {k: jnp.asarray(self.arg_params[k].asnumpy()) for k in param_names}
         aux = {k: jnp.asarray(self.aux_params[k].asnumpy()) for k in aux_names}
-        opt_state = optimizer.init_state_tree(params)
+        opt_state = {} if async_kv else optimizer.init_state_tree(params)
         if resume_opt_leaves is not None:
             # restore momentum/moments: re-thread the saved flat leaves
             # through this optimizer's state structure
@@ -433,7 +463,8 @@ class FeedForward(BASE_ESTIMATOR):
                     train_steps[bkey] = self._build_train_step(
                         b_dnames, b_lnames, optimizer, mesh,
                         symbol=self._symbol_for_bucket(bkey),
-                        metric_update=metric_update)
+                        metric_update=metric_update,
+                        apply_update=not async_kv)
                 train_step = train_steps[bkey]
                 batch_arrays = {}
                 for name, arr in zip(b_dnames, batch.data):
@@ -446,6 +477,17 @@ class FeedForward(BASE_ESTIMATOR):
                 params, opt_state, aux, outs, maccum.state = train_step(
                     params, opt_state, aux, batch_arrays, rng, lr, maccum.state
                 )
+                if async_kv:
+                    # params slot carries grads (apply_update=False): one
+                    # round trip pushes all of them (updated on arrival),
+                    # one pulls fresh weights — unbounded-staleness async,
+                    # like the reference's dist_async worker loop
+                    kv.push_many({name: _host_local(params[name])
+                                  for name in param_names})
+                    pulled = kv.pull_many(param_names)
+                    for name in param_names:
+                        self.arg_params[name] = NDArray(pulled[name])
+                    params = {k: jnp.asarray(pulled[k]) for k in param_names}
                 num_update += 1
                 if use_device_metric:
                     maccum.after_batch(batch.label)

@@ -216,3 +216,17 @@ def test_perplexity_and_topk_device_host_parity():
                                   [jnp.asarray(probs)])
         dev.absorb_device_state(state)
         np.testing.assert_allclose(dev.get()[1], host.get()[1], rtol=1e-5)
+
+
+def test_fit_dist_async_kvstore_single_process():
+    """fit(kvstore='dist_async') runs the real update-on-kvstore path: the
+    optimizer executes on the parameter host (loopback server in single
+    process), workers push grads / pull weights each batch — and still
+    converges (reference semantics: update-on-arrival, no BSP round)."""
+    X, y = _two_blob_dataset()
+    model = mx.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=8,
+                           learning_rate=0.5, optimizer="sgd", momentum=0.9)
+    model.fit(X, y, batch_size=40, kvstore="dist_async")
+    preds = model.predict(X, batch_size=40)
+    acc = (preds.argmax(axis=1) == y).mean()
+    assert acc > 0.95, f"accuracy {acc}"

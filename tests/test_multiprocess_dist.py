@@ -81,3 +81,20 @@ def test_dist_async_kvstore_2proc():
     assert res.returncode == 0, res.stderr[-2000:]
     assert res.stdout.count("dist_async semantics OK (value = 5)") == 2, \
         res.stdout + res.stderr[-2000:]
+
+
+@pytest.mark.slow
+def test_dist_async_mlp_2proc():
+    """End-to-end async-PS training across 2 real processes: optimizer on
+    the parameter host, per-batch push/pull, no collectives (reference:
+    multi-node/dist_async_mlp.py convergence test)."""
+    script = os.path.join(REPO, "examples", "distributed", "dist_async_mlp.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", sys.executable, script],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert res.stdout.count("dist_async_mlp accuracy") == 2, \
+        res.stdout + res.stderr[-2000:]
