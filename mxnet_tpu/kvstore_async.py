@@ -19,7 +19,9 @@ training should prefer ``dist_sync`` (in-jit psum over the mesh), which is
 the idiomatic TPU fast path.
 
 Wire protocol: 4-byte big-endian length + pickle of (op, *args); one reply
-per request. Ops: init / push / pull / set_optimizer / barrier / stop.
+per request. Ops: init / push / pull / push_many / pull_many / push_pull
+(apply grads + return updated weights, the trainer's one-round-trip batch
+sync) / set_optimizer / barrier / stop.
 """
 
 from __future__ import annotations
@@ -139,7 +141,7 @@ class _AsyncServer:
                     _send_msg(conn, ("err", f"key {key!r} not initialized"))
                     return False
                 _send_msg(conn, ("ok", self.store[key].copy()))
-        elif op == "push_many":
+        elif op in ("push_many", "push_pull"):
             _, kvs = msg  # dict key -> np array: ONE round trip per batch
             with self.lock:
                 missing = [k for k in kvs if k not in self.store]
@@ -152,6 +154,11 @@ class _AsyncServer:
                                      self.store[k])
                     else:
                         self.store[k] = np.array(value, np.float32)
+                if op == "push_pull":  # reply with updated weights: the
+                    # trainer's per-batch sync in ONE round trip
+                    _send_msg(conn, ("ok", {k: self.store[k].copy()
+                                            for k in kvs}))
+                    return False
             _send_msg(conn, ("ok",))
         elif op == "pull_many":
             _, keys = msg
@@ -295,6 +302,13 @@ class AsyncKVStore(KVStore):
     def pull_many(self, keys) -> dict:
         """Pull current values for ``keys`` in one round trip."""
         return self._call("pull_many", list(keys))
+
+    def push_pull(self, kvs: dict) -> dict:
+        """Apply grads and return the updated weights in ONE round trip —
+        the trainer's whole per-batch parameter-host sync."""
+        return self._call("push_pull",
+                          {k: np.asarray(v, np.float32)
+                           for k, v in kvs.items()})
 
     def set_updater(self, updater):
         raise MXNetError(

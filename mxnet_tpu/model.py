@@ -413,6 +413,13 @@ class FeedForward(BASE_ESTIMATOR):
         self._optimizer_obj = optimizer
 
         if async_kv:
+            if sharded_checkpoint_dir is not None:
+                raise MXNetError(
+                    "sharded_checkpoint_dir is not supported with "
+                    "kvstore='dist_async': workers hold diverged replicas "
+                    "and would race on one checkpoint directory; use "
+                    "epoch_end_callback=mx.callback.do_checkpoint(prefix) "
+                    "with a per-worker prefix instead")
             # update_on_kvstore=True semantics: the optimizer runs on the
             # parameter host on every push (reference: pickled-optimizer
             # transport + server-side updater); rank 0's weights initialize
@@ -478,15 +485,13 @@ class FeedForward(BASE_ESTIMATOR):
                     params, opt_state, aux, batch_arrays, rng, lr, maccum.state
                 )
                 if async_kv:
-                    # params slot carries grads (apply_update=False): one
-                    # round trip pushes all of them (updated on arrival),
-                    # one pulls fresh weights — unbounded-staleness async,
-                    # like the reference's dist_async worker loop
-                    kv.push_many({name: _host_local(params[name])
-                                  for name in param_names})
-                    pulled = kv.pull_many(param_names)
-                    for name in param_names:
-                        self.arg_params[name] = NDArray(pulled[name])
+                    # params slot carries grads (apply_update=False): ONE
+                    # round trip applies them on the host (updated on
+                    # arrival) and returns the fresh weights —
+                    # unbounded-staleness async, like the reference's
+                    # dist_async worker loop
+                    pulled = kv.push_pull({name: _host_local(params[name])
+                                           for name in param_names})
                     params = {k: jnp.asarray(pulled[k]) for k in param_names}
                 num_update += 1
                 if use_device_metric:

@@ -188,6 +188,30 @@ def test_monitor():
     assert all("fc1" in name for _, name, _ in stats)
 
 
+def test_monitor_sees_bn_output_under_fusion():
+    """The executor fuses BatchNorm->relu, but Monitor's get_internals()
+    graph makes every node a head — fusion is suppressed there and the
+    observed BN output is the true pre-relu value."""
+    from mxnet_tpu import symbol as S
+
+    bn = S.BatchNorm(data=S.Variable("data"), name="bn")
+    net = S.Activation(data=bn, act_type="relu", name="relu")
+    exe = net.simple_bind(mx.cpu(), data=(4, 3, 5, 5))
+    rng = np.random.RandomState(0)
+    exe.arg_dict["data"][:] = rng.randn(4, 3, 5, 5).astype(np.float32)
+    exe.arg_dict["bn_gamma"][:] = np.ones(3, np.float32)
+    mon = mx.Monitor(interval=1, stat_func=lambda x: x.min(),
+                     pattern=".*bn.*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    stats = mon.toc()
+    bn_stats = [v for _, name, v in stats if name == "bn_output"]
+    assert bn_stats, f"no bn_output stat in {[s[1] for s in stats]}"
+    # pre-relu BN output must go negative; post-relu would be >= 0
+    assert float(bn_stats[0]) < 0
+
+
 def test_visualization():
     net = _mlp_sym()
     dot = mx.viz.plot_network(net, title="mlp")
