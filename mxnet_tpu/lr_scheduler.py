@@ -8,8 +8,8 @@ from __future__ import annotations
 
 import math
 
-__all__ = ["LRScheduler", "FixedScheduler", "FactorScheduler",
-           "MultiFactorScheduler", "CosineScheduler"]
+__all__ = ["LRScheduler", "LearningRateScheduler", "FixedScheduler",
+           "FactorScheduler", "MultiFactorScheduler", "CosineScheduler"]
 
 
 class LRScheduler:
@@ -67,3 +67,7 @@ class CosineScheduler(LRScheduler):
             return self.base_lr * (num_update + 1) / max(1, self.warmup)
         t = min(1.0, (num_update - self.warmup) / max(1, self.max_update - self.warmup))
         return self.final_lr + 0.5 * (self.base_lr - self.final_lr) * (1 + math.cos(math.pi * t))
+
+
+# reference alias (misc.py names the base class LearningRateScheduler)
+LearningRateScheduler = LRScheduler
