@@ -60,6 +60,64 @@ def _check_grads(sym, input_shapes, aux_values=None, n_samples=8, atol=2e-2,
                 (name, i, numeric, g_flat[i])
 
 
+def test_fullyconnected_grad():
+    sym = S.FullyConnected(data=S.Variable("data"), num_hidden=5, name="fc")
+    _check_grads(sym, {"data": (4, 6)})
+
+
+def test_convolution_grad_nchw():
+    sym = S.Convolution(data=S.Variable("data"), kernel=(3, 3), pad=(1, 1),
+                        num_filter=4, name="c")
+    _check_grads(sym, {"data": (2, 3, 6, 6)})
+
+
+def test_convolution_grad_nhwc_1x1_dot_path():
+    """The NHWC 1x1 fast path lowers as dot_general (ops/nn.py); its
+    autodiff must match finite differences, including the strided variant
+    that slices before the GEMM."""
+    sym = S.Convolution(data=S.Variable("data"), kernel=(1, 1), num_filter=6,
+                        layout="NHWC", name="c")
+    _check_grads(sym, {"data": (2, 5, 5, 4)})
+    sym = S.Convolution(data=S.Variable("data"), kernel=(1, 1), num_filter=6,
+                        stride=(2, 2), layout="NHWC", name="c")
+    _check_grads(sym, {"data": (2, 6, 6, 4)}, seed=1)
+
+
+def test_convolution_grad_grouped():
+    sym = S.Convolution(data=S.Variable("data"), kernel=(3, 3), pad=(1, 1),
+                        num_filter=4, num_group=2, name="c")
+    _check_grads(sym, {"data": (2, 4, 5, 5)}, seed=2)
+
+
+def test_unary_grads():
+    for name in ("exp", "square", "abs"):
+        sym = getattr(S, name)(S.Variable("data"))
+        _check_grads(sym, {"data": (3, 4)}, seed=4)
+    for name in ("log", "sqrt"):
+        # compose under exp to keep the argument positive at any sample
+        sym = getattr(S, name)(S.exp(S.Variable("data")))
+        _check_grads(sym, {"data": (3, 4)}, seed=4)
+
+
+def test_blockgrad_stops_gradient():
+    data = S.Variable("data")
+    sym = S.FullyConnected(data=S.BlockGrad(data=data), num_hidden=3,
+                           name="fc")
+    graph_fn = _build_graph_fn(sym, is_train=True)
+    rng = np.random.RandomState(0)
+    vals = {n: jnp.asarray(rng.randn(*s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(),
+                            sym.infer_shape(data=(2, 4))[0])}
+
+    def loss(v):
+        outs, _ = graph_fn(v, {}, jax.random.PRNGKey(0))
+        return jnp.sum(outs[0] ** 2)
+
+    grads = jax.grad(loss)(vals)
+    np.testing.assert_allclose(grads["data"], 0.0)  # blocked
+    assert float(jnp.abs(grads["fc_weight"]).sum()) > 0  # flows elsewhere
+
+
 def test_deconvolution_grad():
     sym = S.Deconvolution(data=S.Variable("data"), kernel=(3, 3),
                           stride=(2, 2), num_filter=3, name="dc")
