@@ -22,6 +22,7 @@ these from the process topology.
 from __future__ import annotations
 
 import gzip
+import logging
 import os
 import struct
 
@@ -288,11 +289,20 @@ class ImageRecordIter(DataIter):
                          "host->device traffic; normalize on device)"),
     }
 
+    # reference augmenter/normalizer flags we don't implement: accepted with
+    # a warning (not an error) so scripts ported from the reference keep
+    # running (dmlc tightening release-note: unknown kwargs otherwise raise)
+    tolerated = ("verbose", "max_random_contrast", "max_random_illumination",
+                 "max_img_size", "min_img_size", "max_random_scale",
+                 "min_random_scale", "rotate", "mirror", "crop_x_start",
+                 "crop_y_start")
+
     def __init__(self, **kwargs):
         super().__init__()
         from .. import recordio as rio
 
-        cfg = apply_params(type(self).__name__, type(self).params, kwargs)
+        cfg = apply_params(type(self).__name__, type(self).params, kwargs,
+                           tolerated=type(self).tolerated)
         path_imgrec = cfg["path_imgrec"]
         data_shape = cfg["data_shape"]
         batch_size = cfg["batch_size"]
@@ -396,8 +406,15 @@ class ImageRecordIter(DataIter):
                 self._mean = self._compute_and_cache_mean(compute_mean, offsets)
             else:
                 # other shards wait for worker 0's cache rather than each
-                # decoding the full dataset redundantly
-                self._mean = self._wait_for_mean(compute_mean)
+                # decoding the full dataset redundantly. This assumes
+                # part_index>0 workers share a filesystem with worker 0
+                # (true single-host multi-process; NOT guaranteed multi-host)
+                # — if the cache doesn't appear within the grace period we
+                # assume no shared FS and compute the mean locally instead
+                # of polling for an hour.
+                self._mean = self._wait_for_mean(
+                    compute_mean, fallback=lambda: self._compute_and_cache_mean(
+                        compute_mean, offsets))
 
         # Prefer the native C++ pipeline (RecordIO + libjpeg decode + augment
         # in worker threads, mxnet_tpu/native) when the records are JPEG and
@@ -449,6 +466,14 @@ class ImageRecordIter(DataIter):
             save as nd_save
 
         c, th, tw = self.data_shape
+        # marker so part_index>0 workers on a shared FS can tell "worker 0
+        # is computing, keep waiting" from "no shared FS, compute locally"
+        marker = f"{path}.inprogress"
+        try:
+            with open(marker, "a"):
+                pass
+        except OSError:
+            marker = None
         acc = np.zeros((th, tw, c), np.float64)
         with open_uri(self._path, "rb") as f:
             for off in offsets:
@@ -466,26 +491,61 @@ class ImageRecordIter(DataIter):
                 top, left = (h - th) // 2, (w - tw) // 2
                 acc += img[top:top + th, left:left + tw].astype(np.float64)
         mean = (acc / len(offsets)).astype(np.float32).transpose(2, 0, 1)
-        if os.path.exists(path):  # another worker won the race: use its file
-            return nd_load(path)["mean_img"].asnumpy()
-        tmp = f"{path}.tmp.{os.getpid()}"
-        nd_save(tmp, {"mean_img": nd_array(mean)})
-        os.replace(tmp, path)
+        try:
+            if os.path.exists(path):  # another worker won the race
+                return nd_load(path)["mean_img"].asnumpy()
+            tmp = f"{path}.tmp.{os.getpid()}"
+            nd_save(tmp, {"mean_img": nd_array(mean)})
+            os.replace(tmp, path)
+        finally:
+            if marker is not None:
+                try:
+                    os.unlink(marker)
+                except OSError:
+                    pass
         logging.info("ImageRecordIter: computed mean image over %d records, "
                      "saved to %s", len(offsets), path)
         return mean
 
-    def _wait_for_mean(self, path, timeout=3600.0, poll=1.0):
+    def _wait_for_mean(self, path, grace=120.0, timeout=3600.0, poll=1.0,
+                       fallback=None):
         """Poll for worker 0's mean cache (os.replace makes it appear
-        atomically and complete)."""
+        atomically and complete). Worker 0 drops a ``path + '.inprogress'``
+        marker while computing, so on a shared filesystem we see the marker
+        within seconds and wait the full ``timeout`` for the (possibly
+        slow) full-dataset pass. If NEITHER the cache nor the marker shows
+        up within ``grace`` seconds (MXNET_TPU_MEAN_WAIT_SEC overrides),
+        there is no shared filesystem with the part_index=0 worker: invoke
+        ``fallback`` (compute the mean locally — identical result,
+        redundant decode pass) or raise with a hint."""
         import time as _time
 
-        deadline = _time.monotonic() + timeout
+        grace = float(os.environ.get("MXNET_TPU_MEAN_WAIT_SEC", grace))
+        marker = f"{path}.inprogress"
+        start = _time.monotonic()
+        seen_marker = False
         while not os.path.exists(path):
-            if _time.monotonic() > deadline:
+            seen_marker = seen_marker or os.path.exists(marker)
+            waited = _time.monotonic() - start
+            if not seen_marker and waited > grace:
+                if fallback is not None:
+                    logging.warning(
+                        "ImageRecordIter: neither mean image cache %r nor "
+                        "its .inprogress marker appeared within %.0fs — "
+                        "assuming no shared filesystem with the "
+                        "part_index=0 worker; computing the mean locally",
+                        path, grace)
+                    return fallback()
                 raise MXNetError(
                     f"timed out waiting for mean image cache {path!r} "
-                    "(is the part_index=0 worker running?)")
+                    "(is the part_index=0 worker running, and does it share "
+                    "a filesystem with this worker? Set "
+                    "MXNET_TPU_MEAN_WAIT_SEC to adjust the wait.)")
+            if waited > timeout:
+                raise MXNetError(
+                    f"timed out after {timeout:.0f}s waiting for mean image "
+                    f"cache {path!r} (worker 0's compute pass did not "
+                    "finish)")
             _time.sleep(poll)
         from ..ndarray import load as nd_load
 

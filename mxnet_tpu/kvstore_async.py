@@ -18,10 +18,29 @@ This path is for the explicit ``create('dist_async')`` API; synchronous
 training should prefer ``dist_sync`` (in-jit psum over the mesh), which is
 the idiomatic TPU fast path.
 
-Wire protocol: 4-byte big-endian length + pickle of (op, *args); one reply
-per request. Ops: init / push / pull / push_many / pull_many / push_pull
-(apply grads + return updated weights, the trainer's one-round-trip batch
-sync) / set_optimizer / barrier / stop.
+Wire protocol (one reply per request). Each message is framed as:
+
+    >I header_len | header | >I nbuf | nbuf x ( >Q buf_len | raw bytes )
+
+where ``header`` is a pickle of ``(op, *args)`` in which every numpy
+tensor payload has been replaced by a small ``_TensorRef(index, dtype,
+shape)`` marker and its bytes moved to the raw-buffer section — so bulk
+float data crosses the socket as raw frames (sent straight from the
+array's memoryview, received with a single ``np.frombuffer``), never
+through the pickler. Ops: init / push / pull / push_many / pull_many /
+push_pull (apply grads + return updated weights, the trainer's
+one-round-trip batch sync) / set_optimizer / barrier / stop.
+
+The parameter-host port is OS-assigned by the launcher at job start and
+published to every process via ``MXTPU_ASYNC_PORT`` (tools/launch.py);
+the old coordinator-port+1 convention remains only as a fallback for
+environments launched without the env var.
+
+Scale note: this transport is the documented NON-idiomatic path — one
+socket per worker, full-model frames per batch, no compression or
+backpressure. Its semantics (update-on-arrival, unbounded staleness) are
+tested; at real scale the wire would dominate and ``dist_sync``'s in-jit
+psum path is the one that scales.
 """
 
 from __future__ import annotations
@@ -40,27 +59,96 @@ from .ndarray import NDArray
 
 __all__ = ["AsyncKVStore"]
 
-_MAGIC = b"mxta"
+_MAGIC = b"mxtb"  # bumped from mxta: raw-buffer tensor frames
+
+
+class _TensorRef:
+    """Placeholder left in the pickled header where a tensor's bytes were
+    moved to the raw-buffer section of the frame."""
+
+    __slots__ = ("index", "dtype", "shape")
+
+    def __init__(self, index, dtype, shape):
+        self.index, self.dtype, self.shape = index, dtype, shape
+
+    def __getstate__(self):
+        return (self.index, self.dtype, self.shape)
+
+    def __setstate__(self, state):
+        self.index, self.dtype, self.shape = state
+
+
+def _extract_tensors(obj, bufs):
+    """Replace ndarrays in obj (recursing through dict/list/tuple) with
+    _TensorRef markers, appending their raw bytes to ``bufs``."""
+    if isinstance(obj, np.ndarray):
+        ref = _TensorRef(len(bufs), obj.dtype.str, obj.shape)
+        bufs.append(np.ascontiguousarray(obj))
+        return ref
+    if isinstance(obj, dict):
+        return {k: _extract_tensors(v, bufs) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_extract_tensors(v, bufs) for v in obj)
+    return obj
+
+
+def _restore_tensors(obj, bufs):
+    if isinstance(obj, _TensorRef):
+        # each buffer is its own bytearray, so frombuffer is already
+        # writable and owns the only reference: no copy needed
+        arr = np.frombuffer(bufs[obj.index], dtype=np.dtype(obj.dtype))
+        return arr.reshape(obj.shape)
+    if isinstance(obj, dict):
+        return {k: _restore_tensors(v, bufs) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_restore_tensors(v, bufs) for v in obj)
+    return obj
+
+
+def _encode_msg(obj):
+    """Frame a message: header pickle (tensors swapped for refs) + raw
+    buffers. Returns a list of bytes-like pieces to send."""
+    bufs: list = []
+    header = pickle.dumps(_extract_tensors(obj, bufs),
+                          protocol=pickle.HIGHEST_PROTOCOL)
+    pieces = [struct.pack(">I", len(header)), header,
+              struct.pack(">I", len(bufs))]
+    for b in bufs:
+        mv = memoryview(b).cast("B")
+        pieces.append(struct.pack(">Q", mv.nbytes))
+        pieces.append(mv)
+    return pieces
 
 
 def _send_msg(sock, obj):
-    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack(">I", len(blob)) + blob)
+    for piece in _encode_msg(obj):
+        sock.sendall(piece)
 
 
 def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    """Receive exactly n bytes into one preallocated writable buffer
+    (recv_into: no quadratic bytes+= growth; the returned bytearray backs
+    np.frombuffer writably, so tensors need no trailing copy)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
             raise ConnectionError("peer closed")
-        buf += chunk
+        got += r
     return buf
 
 
 def _recv_msg(sock):
     (n,) = struct.unpack(">I", _recv_exact(sock, 4))
-    return pickle.loads(_recv_exact(sock, n))
+    header = pickle.loads(_recv_exact(sock, n))
+    (nbuf,) = struct.unpack(">I", _recv_exact(sock, 4))
+    bufs = []
+    for _ in range(nbuf):
+        (blen,) = struct.unpack(">Q", _recv_exact(sock, 8))
+        bufs.append(_recv_exact(sock, blen))
+    return _restore_tensors(header, bufs)
 
 
 class _AsyncServer:
@@ -77,6 +165,9 @@ class _AsyncServer:
         self._barrier_count = 0
         self._barrier_round = 0
         self._stopped = 0
+        # total push REQUESTS applied on arrival: one per push_many/
+        # push_pull batch, one per key for the legacy single-key push op
+        self.update_count = 0
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -128,6 +219,7 @@ class _AsyncServer:
                     _send_msg(conn, ("err", f"key {key!r} not initialized"))
                     return False
                 # update-on-arrival: no waiting for other workers
+                self.update_count += 1
                 if self.updater is not None:
                     self.updater(key, np.asarray(value, np.float32),
                                  self.store[key])
@@ -140,26 +232,34 @@ class _AsyncServer:
                 if key not in self.store:
                     _send_msg(conn, ("err", f"key {key!r} not initialized"))
                     return False
-                _send_msg(conn, ("ok", self.store[key].copy()))
+                value = self.store[key].copy()
+            # serialize + send OUTSIDE the lock: other workers' syncs must
+            # not stall behind this connection's socket write
+            _send_msg(conn, ("ok", value))
         elif op in ("push_many", "push_pull"):
             _, kvs = msg  # dict key -> np array: ONE round trip per batch
+            reply = None
             with self.lock:
                 missing = [k for k in kvs if k not in self.store]
                 if missing:
                     _send_msg(conn, ("err", f"keys not initialized: {missing}"))
                     return False
+                self.update_count += 1
                 for k, value in kvs.items():
                     if self.updater is not None:
                         self.updater(k, np.asarray(value, np.float32),
                                      self.store[k])
                     else:
                         self.store[k] = np.array(value, np.float32)
-                if op == "push_pull":  # reply with updated weights: the
-                    # trainer's per-batch sync in ONE round trip
-                    _send_msg(conn, ("ok", {k: self.store[k].copy()
-                                            for k in kvs}))
-                    return False
-            _send_msg(conn, ("ok",))
+                if op == "push_pull":
+                    # copy the updated weights under the lock; frame + send
+                    # the (large) reply after releasing it so each worker's
+                    # batch sync doesn't serialize the fleet on one socket
+                    reply = {k: self.store[k].copy() for k in kvs}
+            if op == "push_pull":
+                _send_msg(conn, ("ok", reply))
+            else:
+                _send_msg(conn, ("ok",))
         elif op == "pull_many":
             _, keys = msg
             with self.lock:
@@ -167,7 +267,11 @@ class _AsyncServer:
                 if missing:
                     _send_msg(conn, ("err", f"keys not initialized: {missing}"))
                     return False
-                _send_msg(conn, ("ok", {k: self.store[k].copy() for k in keys}))
+                values = {k: self.store[k].copy() for k in keys}
+            _send_msg(conn, ("ok", values))
+        elif op == "stats":
+            with self.lock:
+                _send_msg(conn, ("ok", {"update_count": self.update_count}))
         elif op == "set_optimizer":
             _, blob = msg
             from .optimizer import get_updater
@@ -223,7 +327,10 @@ class AsyncKVStore(KVStore):
         coord = os.environ.get("MXTPU_COORDINATOR")
         if coord:
             host, port = coord.rsplit(":", 1)
-            # deterministic offset from the coordination-service port
+            async_port = os.environ.get("MXTPU_ASYNC_PORT")
+            if async_port:  # OS-assigned by the launcher, collision-free
+                return host, int(async_port)
+            # legacy fallback: deterministic offset from the coordinator port
             return host, int(port) + 1
         # standalone single process: loopback on an os-assigned port
         if self._nproc != 1:
@@ -322,6 +429,12 @@ class AsyncKVStore(KVStore):
 
     def barrier(self):
         self._call("barrier")
+
+    def stats(self) -> dict:
+        """Server-side counters ({'update_count': N} — push requests
+        applied on arrival: one per push_many/push_pull batch, one per key
+        for legacy single-key push), for staleness characterization."""
+        return self._call("stats")
 
     def __del__(self):
         try:

@@ -360,7 +360,8 @@ class DropoutOp(OpProp):
     """Inverted dropout (reference: dropout-inl.h — scales by 1/keep at train
     time, identity at eval)."""
 
-    params = {"p": (Range(float, lo=0.0, hi=1.0), 0.5, "fraction of units to drop")}
+    params = {"p": (Range(float, lo=0.0, hi=1.0, hi_exclusive=True), 0.5,
+                    "fraction of units to drop")}
     need_rng = True
 
     def fwd(self, ins, aux, is_train, rng):

@@ -53,17 +53,23 @@ class TupleParam:
 
 
 class Range:
-    """Numeric param with inclusive bounds: ``Range(int, lo=1)`` etc."""
+    """Numeric param with bounds: ``Range(int, lo=1)`` etc. Bounds are
+    inclusive unless ``hi_exclusive`` (e.g. Dropout p < 1, where p == 1
+    would make keep == 0 and divide by zero at train time)."""
 
-    def __init__(self, typ, lo=None, hi=None):
+    def __init__(self, typ, lo=None, hi=None, hi_exclusive=False):
         self.typ, self.lo, self.hi = typ, lo, hi
+        self.hi_exclusive = hi_exclusive
 
     def __call__(self, value):
         value = self.typ(value)
         if self.lo is not None and value < self.lo:
             raise MXNetError(f"expected value >= {self.lo}, got {value}")
-        if self.hi is not None and value > self.hi:
-            raise MXNetError(f"expected value <= {self.hi}, got {value}")
+        if self.hi is not None:
+            if self.hi_exclusive and value >= self.hi:
+                raise MXNetError(f"expected value < {self.hi}, got {value}")
+            if not self.hi_exclusive and value > self.hi:
+                raise MXNetError(f"expected value <= {self.hi}, got {value}")
         return value
 
     @property
@@ -72,7 +78,7 @@ class Range:
         if self.lo is not None:
             bounds.append(f">= {self.lo}")
         if self.hi is not None:
-            bounds.append(f"<= {self.hi}")
+            bounds.append(("< " if self.hi_exclusive else "<= ") + str(self.hi))
         return f"{self.typ.__name__} ({', '.join(bounds)})" if bounds else \
             self.typ.__name__
 
@@ -89,19 +95,39 @@ def coerce(typ, value):
     return typ(value)
 
 
-def apply_params(owner_name: str, spec: dict, kwargs: dict) -> dict:
+def apply_params(owner_name: str, spec: dict, kwargs: dict,
+                 tolerated=()) -> dict:
     """Validate ``kwargs`` against ``spec``; return the full normalized dict.
 
     Unknown keys, missing required keys, and out-of-range/unparseable values
     raise :class:`MXNetError` naming the owner and the field (dmlc parity:
-    dmlc::ParamError prints the struct and field name).
+    dmlc::ParamError prints the struct and field name). Keys in
+    ``tolerated`` (reference-only flags that scripts ported from the
+    reference may still pass) are accepted with a warning and dropped.
     """
     out = {}
     for key, value in kwargs.items():
         if key not in spec:
+            if key in tolerated:
+                import warnings
+
+                warnings.warn(
+                    f"{owner_name}: parameter {key!r} is a reference-only "
+                    f"flag with no effect here; ignored", stacklevel=3)
+                continue
             raise MXNetError(
                 f"{owner_name}: unknown parameter {key!r}; "
                 f"accepts {sorted(spec)}")
+        if value is None:
+            if spec[key][1] is REQUIRED:
+                raise MXNetError(
+                    f"{owner_name}: parameter {key!r} is required "
+                    "(got None)")
+            # Explicit None means "use the default" — many reference call
+            # sites pass None for params whose old signature default was
+            # None (ImageRecordIter(mean_img=None), CSVIter(label_csv=None),
+            # preprocess_threads=None); coercing would produce 'None'/raise.
+            continue
         try:
             out[key] = coerce(spec[key][0], value)
         except MXNetError as e:

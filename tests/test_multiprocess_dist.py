@@ -84,6 +84,25 @@ def test_dist_async_kvstore_2proc():
 
 
 @pytest.mark.slow
+def test_dist_async_staleness_4proc():
+    """4 workers at skewed speeds (rank*50ms per batch): every worker
+    completes unblocked, the server's update_count equals the total pushed
+    batches, and training converges despite stale gradients."""
+    script = os.path.join(REPO, "examples", "distributed",
+                          "dist_async_staleness.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "4", sys.executable, script],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "dist_async_staleness OK" in res.stdout, \
+        res.stdout + res.stderr[-2000:]
+    assert res.stdout.count("completed 12 batches") == 4, res.stdout
+
+
+@pytest.mark.slow
 def test_dist_async_mlp_2proc():
     """End-to-end async-PS training across 2 real processes: optimizer on
     the parameter host, per-batch push/pull, no collectives (reference:

@@ -72,6 +72,36 @@ def test_iterator_docstrings_generated():
     assert "Parameters" in mio.CSVIter.__doc__
 
 
+def test_explicit_none_means_default():
+    """Passing None for an optional param behaves like omitting it (many
+    reference call sites pass None for old signature defaults)."""
+    spec = {"mean_img": (str, None, "path"),
+            "threads": (int, 4, "n"),
+            "req": (int, REQUIRED, "r")}
+    out = apply_params("It", spec, {"mean_img": None, "threads": None,
+                                    "req": 2})
+    assert out["mean_img"] is None  # NOT the string 'None'
+    assert out["threads"] == 4
+    assert out["req"] == 2
+
+
+def test_dropout_p_upper_bound_exclusive():
+    """p == 1 would make keep == 0 (divide by zero at train time)."""
+    with pytest.raises(MXNetError, match="'p'.*< 1.0"):
+        sym.Dropout(data=sym.Variable("d"), p=1.0)
+    sym.Dropout(data=sym.Variable("d"), p=0.99)  # ok
+
+
+def test_reference_only_flags_tolerated_with_warning():
+    """Reference augmenter flags we don't implement warn instead of raise
+    (scripts ported from the reference keep running)."""
+    with pytest.warns(UserWarning, match="reference-only"):
+        with pytest.raises((MXNetError, FileNotFoundError)):
+            mio.ImageRecordIter(path_imgrec="/nonexistent.rec",
+                                data_shape=(3, 8, 8), batch_size=2,
+                                max_random_contrast=0.5, verbose=True)
+
+
 def test_string_coercion_like_dmlc():
     """dmlc parses stringly-typed configs; '(2,2)' / 'true' / '0.5' all work."""
     op = sym.Convolution(data=sym.Variable("d"), kernel="(3,3)",

@@ -42,6 +42,11 @@ def main():
         ap.error("no command given")
 
     port = _free_port()
+    # OS-assigned port for the dist_async parameter host, published to every
+    # process (collision-free, unlike deriving coordinator-port+1)
+    async_port = _free_port()
+    while async_port == port:
+        async_port = _free_port()
     coordinator = f"127.0.0.1:{port}"
     procs = []
 
@@ -50,6 +55,7 @@ def main():
         env.update({
             "MXTPU_NUM_WORKERS": str(args.num_workers),
             "MXTPU_COORDINATOR": coordinator,
+            "MXTPU_ASYNC_PORT": str(async_port),
             # reference names, for ported scripts
             "DMLC_ROLE": role,
             "DMLC_NUM_WORKER": str(args.num_workers),
