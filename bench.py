@@ -43,9 +43,71 @@ import time
 import numpy as np
 
 
+NOMINAL_BF16_TFLOPS = 197.0  # TPU v5e peak per chip (public spec)
+
+
 def _data_shape(batch_size, layout):
     return (batch_size, 224, 224, 3) if layout == "NHWC" else \
         (batch_size, 3, 224, 224)
+
+
+def with_retries(fn, tries=4, what="tpu op"):
+    """Retry transient tunnel failures (the round-2 bench died rc=1 on a
+    wedged compile service; UNAVAILABLE from the axon backend is retryable)."""
+    delays = [20, 60, 120]
+    for attempt in range(tries):
+        try:
+            return fn()
+        except RuntimeError as e:  # includes jax.errors.JaxRuntimeError
+            msg = str(e)
+            retryable = "UNAVAILABLE" in msg or "Unable to initialize" in msg
+            if not retryable or attempt == tries - 1:
+                raise
+            delay = delays[min(attempt, len(delays) - 1)]
+            print(f"{what}: transient backend error, retrying in {delay}s "
+                  f"({attempt + 1}/{tries - 1}): {msg.splitlines()[0][:120]}",
+                  file=sys.stderr)
+            time.sleep(delay)
+
+
+def measured_matmul_peak_tflops(n=8192, iters=16, samples=3):
+    """This chip's achievable bf16 matmul rate, measured through the same
+    tunnel/timing path as the headline number. Slope method: the loop runs
+    in-device via fori_loop and the per-iter cost is the slope between a
+    short and a long run, cancelling constant dispatch+fence overhead.
+    n=8192 (1.1 TFLOP/iter) keeps the timed region hundreds of ms so
+    tunnel-latency jitter (several ms) stays <1%; the median of several
+    slope samples guards against one-off network stalls."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def run(a, k):
+        def body(i, x):
+            return (jax.lax.dot_general(
+                x, b, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * 1e-3).astype(jnp.bfloat16)
+        return jax.lax.fori_loop(0, k, body, a)
+
+    k1, k2 = iters, iters * 4
+    a = run(a, k1)  # compile + warm
+    float(jnp.sum(a))
+    rates = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        a = run(a, k1)
+        float(jnp.sum(a))
+        t1 = time.perf_counter()
+        a = run(a, k2)
+        float(jnp.sum(a))
+        t2 = time.perf_counter()
+        per_iter = ((t2 - t1) - (t1 - t0)) / (k2 - k1)
+        rates.append(2 * n ** 3 / per_iter / 1e12)
+    rates.sort()
+    return rates[len(rates) // 2]
 
 
 def build_resnet50_train_step(batch_size, lr=0.1, momentum=0.9, layout="NHWC"):
@@ -237,7 +299,7 @@ def main():
 
     import jax
 
-    dev = jax.devices()[0]
+    dev = with_retries(lambda: jax.devices()[0], what="device init")
     print(f"bench device: {dev}", file=sys.stderr)
 
     step, params, moms, aux = build_resnet50_train_step(
@@ -250,28 +312,103 @@ def main():
 
     import jax.numpy as jnp
 
+    # Self-accounting FLOPs: XLA's cost analysis of the exact compiled step.
+    step_gflops = None
+    try:
+        compiled = step.lower(params, moms, aux, data, label).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        if ca and "flops" in ca:
+            step_gflops = float(ca["flops"]) / 1e9
+    except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
+        print(f"cost_analysis unavailable: {e}", file=sys.stderr)
+
     def fence():
         # Through the remote-TPU tunnel, block_until_ready acks before the
         # device queue drains; a scalar readback is the only honest sync.
         return float(jnp.sum(params["fc1_bias"]))
 
-    for _ in range(args.warmup):
-        params, moms, aux = step(params, moms, aux, data, label)
-    fence()
+    # Timed region runs ON DEVICE (fori_loop, dynamic trip count) and the
+    # per-step cost is the slope between a short and a long run — each
+    # Python-level dispatch through the tunnel costs ~5-10 ms, which at
+    # ~100 ms steps would shave ~7% off the reported number.
+    def loop_step(s):
+        p, m, a = step(s[0], s[1], s[2], data, label)
+        return (p, m, a)
 
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        params, moms, aux = step(params, moms, aux, data, label)
-    fence()
-    dt = time.perf_counter() - t0
+    @jax.jit
+    def run(s, k):
+        return jax.lax.fori_loop(0, k, lambda i, t: loop_step(t), s)
 
-    images_per_sec = args.batch_size * args.steps / dt
+    if args.steps < 8:
+        print(f"--steps {args.steps} too small for slope timing "
+              "(need >=8); raising to 8", file=sys.stderr)
+        args.steps = 8
+    k1 = max(2, args.steps // 4)
+    k2 = args.steps
+
+    def timed_run():
+        nonlocal params, moms, aux
+        state = (params, moms, aux)
+        state = run(state, k1)  # compile + warm
+        float(jnp.sum(state[0]["fc1_bias"]))
+        t0 = time.perf_counter()
+        state = run(state, k1)
+        float(jnp.sum(state[0]["fc1_bias"]))
+        t1 = time.perf_counter()
+        state = run(state, k2)
+        float(jnp.sum(state[0]["fc1_bias"]))
+        t2 = time.perf_counter()
+        params, moms, aux = state
+        return ((t2 - t1) - (t1 - t0)) / (k2 - k1)
+
+    try:
+        step_time = with_retries(timed_run, what="train step")
+        timing = "device_loop_slope"
+    except Exception as e:  # e.g. loop-carry OOM: fall back to host loop
+        print(f"device-loop timing failed ({e}); host loop", file=sys.stderr)
+        for _ in range(args.warmup):
+            params, moms, aux = step(params, moms, aux, data, label)
+        fence()
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            params, moms, aux = step(params, moms, aux, data, label)
+        fence()
+        step_time = (time.perf_counter() - t0) / args.steps
+        timing = "host_loop"
+
+    images_per_sec = args.batch_size / step_time
+
+    # Honest MFU accounting (VERDICT r2 items 1-2). MFU uses the STANDARD
+    # model-FLOP count (ResNet-50/224 fwd = 4.09 GFLOP at 2 FLOP/MAC,
+    # train = 3x -> 12.27) so the figure is comparable across frameworks;
+    # XLA's cost-analysis count of the actual compiled step (which includes
+    # BN stats, recompute, optimizer arithmetic) is reported alongside.
+    gflop_analytic = 12.27
+    gflop_xla = step_gflops / args.batch_size if step_gflops else None
+    achieved_tflops = images_per_sec * gflop_analytic / 1e3
+    try:
+        peak = with_retries(measured_matmul_peak_tflops, what="peak matmul")
+    except Exception:
+        peak = None
+
     baseline = 97.0  # Inception-BN img/s, 1x GTX 980 cuDNN v3 (BASELINE.md)
     print(json.dumps({
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / baseline, 3),
+        "step_ms": round(step_time * 1e3, 2),
+        "batch_size": args.batch_size,
+        "gflop_per_image": gflop_analytic,
+        "gflop_per_image_xla_cost_model": (round(gflop_xla, 2)
+                                           if gflop_xla else None),
+        "achieved_model_tflops": round(achieved_tflops, 1),
+        "measured_matmul_peak_tflops": round(peak, 1) if peak else None,
+        "mfu_vs_measured_peak": (round(achieved_tflops / peak, 3)
+                                 if peak else None),
+        "mfu_vs_nominal": round(achieved_tflops / NOMINAL_BF16_TFLOPS, 3),
+        "timing": timing,
     }))
 
 

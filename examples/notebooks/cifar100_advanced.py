@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""CIFAR-100-style advanced training knobs (notebook-style walkthrough).
+
+Reference counterpart: example/notebooks/cifar-100.ipynb — a sub-Inception
+network trained with the knobs that mattered for its state-of-the-art run:
+``grad_scale`` on the loss, randomized crop/mirror augmentation, an epoch
+learning-rate schedule, and round_batch handling for a dataset that does
+not divide evenly by the batch size.
+
+  python examples/notebooks/cifar100_advanced.py [--num-epochs 2]
+
+Data: synthetic 100-class CIFAR-shaped JPEG RecordIO (offline-safe).
+"""
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.INFO)
+
+
+def ConvFactory(data, num_filter, kernel, stride=(1, 1), pad=(0, 0)):
+    conv = mx.symbol.Convolution(data=data, num_filter=num_filter,
+                                 kernel=kernel, stride=stride, pad=pad)
+    bn = mx.symbol.BatchNorm(data=conv)
+    return mx.symbol.Activation(data=bn, act_type="relu")
+
+
+def build_net(num_classes=100, grad_scale=1.0):
+    """Small sub-Inception; grad_scale rescales the loss gradient exactly as
+    the reference's SoftmaxOutput(grad_scale=...) — used there to balance
+    multi-loss setups and larger effective batches."""
+    data = mx.symbol.Variable(name="data")
+    c1 = ConvFactory(data, 64, (3, 3), pad=(1, 1))
+    c2a = ConvFactory(c1, 32, (1, 1))
+    c2b = ConvFactory(c1, 32, (3, 3), pad=(1, 1))
+    cat = mx.symbol.Concat(c2a, c2b)
+    down = mx.symbol.Pooling(data=cat, kernel=(3, 3), stride=(2, 2),
+                             pad=(1, 1), pool_type="max")
+    c3a = ConvFactory(down, 64, (1, 1))
+    pool = mx.symbol.Pooling(data=c3a, kernel=(14, 14), pool_type="avg")
+    fc = mx.symbol.FullyConnected(data=mx.symbol.Flatten(data=pool),
+                                  num_hidden=num_classes)
+    return mx.symbol.SoftmaxOutput(data=fc, name="softmax",
+                                   grad_scale=grad_scale)
+
+
+def make_rec(path, n, num_classes=100, seed=0):
+    from mxnet_tpu import recordio as rio
+
+    rng = np.random.RandomState(seed)
+    protos = rng.randint(0, 255, (num_classes, 32, 32, 3), np.uint8)
+    w = rio.MXRecordIO(path, "w")
+    for i in range(n):
+        cls = i % num_classes
+        img = np.clip(protos[cls].astype(np.int16) +
+                      rng.randint(-25, 25, (32, 32, 3), np.int16),
+                      0, 255).astype(np.uint8)
+        w.write(rio.pack_img(rio.IRHeader(0, float(cls), i, 0), img,
+                             img_fmt=".jpg"))
+    w.close()
+    return path
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="cifar100_")
+    # 1500 records / batch 64 does not divide: round_batch wraps the tail
+    # (reference BatchLoader semantics) so every batch is full-size —
+    # essential for XLA's static shapes.
+    train_rec = make_rec(os.path.join(tmp, "train.rec"), 1500, seed=0)
+    val_rec = make_rec(os.path.join(tmp, "val.rec"), 500, seed=1)
+
+    # Randomized crop + mirror is the augmentation the reference notebook
+    # leaned on; 28x28 crops from 32x32 sources give ±4px translation.
+    train_iter = mx.io.ImageRecordIter(
+        path_imgrec=train_rec, data_shape=(3, 28, 28),
+        batch_size=args.batch_size, rand_crop=True, rand_mirror=True,
+        shuffle=True, round_batch=True,
+        mean_r=128, mean_g=128, mean_b=128, scale=1.0 / 128)
+    val_iter = mx.io.ImageRecordIter(
+        path_imgrec=val_rec, data_shape=(3, 28, 28),
+        batch_size=args.batch_size,
+        mean_r=128, mean_g=128, mean_b=128, scale=1.0 / 128)
+
+    # Epoch-factor learning-rate schedule: lr *= 0.9 each epoch.
+    sched = mx.lr_scheduler.FactorScheduler(
+        step=max(1, 1500 // args.batch_size), factor=0.9)
+
+    model = mx.model.FeedForward(
+        symbol=build_net(grad_scale=1.0), ctx=mx.cpu(),
+        num_epoch=args.num_epochs, learning_rate=0.1, momentum=0.9,
+        wd=0.0001, initializer=mx.init.Xavier(),
+        lr_scheduler=sched)
+    model.fit(X=train_iter, eval_data=val_iter, eval_metric="accuracy",
+              batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+
+    prob = model.predict(val_iter)
+    assert prob.shape[1] == 100
+    print("cifar100 advanced walkthrough complete; predicted", prob.shape)
+
+
+if __name__ == "__main__":
+    main()

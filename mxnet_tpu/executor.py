@@ -14,10 +14,14 @@ of that collapses into ``jax.jit``:
   - cached engine ops     : the compiled executable, cached by shapes.
   - Forward/Backward push : one async dispatch of a single fused program.
 
-``backward()`` recompiles forward+backward as one fused program; XLA shares
-the forward subcomputation, so an explicit ``forward(is_train=True)`` +
-``backward()`` pair costs one extra forward vs. the fused train-step path the
-FeedForward trainer uses (model.py).
+``forward(is_train=True)`` on an executor with bound gradients runs a jitted
+program that also emits the VJP residuals (``jax.vjp``'s closure is a
+flattenable pytree, so its leaves ride out of the compiled program);
+``backward()`` is then a pure backward program over those residuals —
+matching the reference contract where Forward/Backward each run their half
+of the graph exactly once (graph_executor.cc:616-643). If residual capture
+is unavailable on a backend, backward falls back to a fused
+forward+backward program (one extra forward).
 
 ``debug_str()`` exposes the compiled HLO and per-executable memory stats,
 keeping the reference's memory-plan introspection story
@@ -197,6 +201,13 @@ class Executor:
         self._needs_rng = any(
             (not n.is_variable) and n.op.need_rng for n in symbol._topo()
         )
+        # residual-capturing forward (see module docstring): jitted fn,
+        # treedef cell, jitted backward-apply, and the live residual leaves
+        self._fwd_res_fn = None
+        self._res_cell: dict = {}
+        self._bwd_apply_fn = None
+        self._res_leaves = None
+        self._res_ok = True  # flips off after a failed capture attempt
 
     # -- public surface -------------------------------------------------------
     @property
@@ -228,10 +239,22 @@ class Executor:
         rng = _random.next_key() if self._needs_rng else jnp.zeros((2,), jnp.uint32)
 
         is_train = bool(is_train)
-        if is_train not in self._fwd_fns:
-            fn = _build_graph_fn(self._symbol, is_train)
-            self._fwd_fns[is_train] = jax.jit(fn)
-        outs, new_aux = self._fwd_fns[is_train](arg_vals, aux_vals, rng)
+        diff_names = self._diff_names()
+        if is_train and diff_names and self._res_ok:
+            try:
+                outs, new_aux = self._forward_with_residuals(
+                    arg_vals, aux_vals, rng, diff_names)
+            except Exception:  # pragma: no cover - backend-dependent
+                self._res_ok = False
+                self._res_leaves = None
+                outs = None
+        else:
+            outs = None
+        if outs is None:
+            if is_train not in self._fwd_fns:
+                fn = _build_graph_fn(self._symbol, is_train)
+                self._fwd_fns[is_train] = jax.jit(fn)
+            outs, new_aux = self._fwd_fns[is_train](arg_vals, aux_vals, rng)
 
         if is_train:
             self._last = (arg_vals, aux_vals, rng)
@@ -244,6 +267,40 @@ class Executor:
                 holder._data = o  # outputs are framework-owned; bypass writable
         return self._outputs
 
+    def _diff_names(self):
+        return sorted(n for n, r in self.grad_req.items() if r != "null")
+
+    def _forward_with_residuals(self, arg_vals, aux_vals, rng, diff_names):
+        """Run forward AND capture the VJP residuals in one compiled program.
+
+        jax.vjp's returned closure is a registered pytree whose leaves are
+        the residual arrays, so a jitted function can emit them; the treedef
+        (recorded at trace time) reconstructs the closure inside the jitted
+        backward. This is what makes Forward/Backward each run once, like
+        the reference's split executor."""
+        if self._fwd_res_fn is None:
+            fwd = _build_graph_fn(self._symbol, True)
+            cell = self._res_cell
+
+            def fwd_res(diff_args, other_args, aux, rng):
+                def inner(d):
+                    outs, new_aux = fwd({**d, **other_args}, aux, rng)
+                    return tuple(outs), new_aux
+
+                outs, vjp_fn, new_aux = jax.vjp(inner, diff_args,
+                                                has_aux=True)
+                leaves, treedef = jax.tree_util.tree_flatten(vjp_fn)
+                cell["treedef"] = treedef
+                return outs, new_aux, leaves
+
+            self._fwd_res_fn = jax.jit(fwd_res)
+        diff_args = {n: arg_vals[n] for n in diff_names}
+        other = {n: v for n, v in arg_vals.items() if n not in diff_args}
+        outs, new_aux, leaves = self._fwd_res_fn(diff_args, other, aux_vals,
+                                                 rng)
+        self._res_leaves = leaves
+        return outs, new_aux
+
     def backward(self, out_grads=None):
         """Compute gradients into the bound grad arrays (reference:
         GraphExecutor::Backward). Seeds ones for missing head gradients; loss
@@ -251,9 +308,36 @@ class Executor:
         if self._last is None:
             raise MXNetError("backward() requires a prior forward(is_train=True)")
         arg_vals, aux_vals, rng = self._last
-        diff_names = sorted(n for n, r in self.grad_req.items() if r != "null")
+        diff_names = self._diff_names()
         if not diff_names:
             return
+        if out_grads is None:
+            cots = tuple(jnp.ones_like(o._data) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = tuple(g._data for g in out_grads)
+
+        if self._res_leaves is not None:
+            if self._bwd_apply_fn is None:
+                cell = self._res_cell
+
+                def bwd_apply(leaves, cots):
+                    vjp_fn = jax.tree_util.tree_unflatten(cell["treedef"],
+                                                          leaves)
+                    (grads,) = vjp_fn(cots)
+                    return grads
+
+                self._bwd_apply_fn = jax.jit(bwd_apply)
+            leaves, self._res_leaves = self._res_leaves, None
+            # drop the residual references as soon as backward consumes them
+            # so activation memory frees before the caller's optimizer
+            # update; a second backward() without a new forward falls
+            # through to the fused-recompute path below
+            grads = self._bwd_apply_fn(leaves, cots)
+            self._write_grads(diff_names, grads)
+            return
+
         if self._bwd_fn is None:
             fwd = _build_graph_fn(self._symbol, True)
 
@@ -268,15 +352,12 @@ class Executor:
 
             self._bwd_fn = jax.jit(bwd)
 
-        if out_grads is None:
-            cots = tuple(jnp.ones_like(o._data) for o in self.outputs)
-        else:
-            if isinstance(out_grads, NDArray):
-                out_grads = [out_grads]
-            cots = tuple(g._data for g in out_grads)
         diff_args = {n: arg_vals[n] for n in diff_names}
         other = {n: v for n, v in arg_vals.items() if n not in diff_args}
         grads = self._bwd_fn(diff_args, other, aux_vals, rng, cots)
+        self._write_grads(diff_names, grads)
+
+    def _write_grads(self, diff_names, grads):
         for n in diff_names:
             req = self.grad_req[n]
             holder = self.grad_dict.get(n)

@@ -475,8 +475,22 @@ class ImageRecordIter(DataIter):
         except OSError:
             marker = None
         acc = np.zeros((th, tw, c), np.float64)
+        last_touch = 0.0
         with open_uri(self._path, "rb") as f:
-            for off in offsets:
+            for i, off in enumerate(offsets):
+                if marker is not None and i % 64 == 0:
+                    # keep the marker's mtime fresh so waiters can tell a
+                    # live computation from a stale marker left by a killed
+                    # run (waiters treat mtime older than ~90s as dead)
+                    import time as _time
+
+                    now = _time.monotonic()
+                    if now - last_touch > 20.0:
+                        last_touch = now
+                        try:
+                            os.utime(marker)
+                        except OSError:
+                            pass
                 raw = rio.read_record_at(f, off)
                 _, img = rio.unpack_img(raw)
                 h, w = img.shape[:2]
@@ -524,9 +538,33 @@ class ImageRecordIter(DataIter):
         marker = f"{path}.inprogress"
         start = _time.monotonic()
         seen_marker = False
+        stale_after = 90.0  # worker 0 touches the marker every ~20s
         while not os.path.exists(path):
-            seen_marker = seen_marker or os.path.exists(marker)
+            marker_live = False
+            try:
+                marker_live = (_time.time() - os.stat(marker).st_mtime
+                               < stale_after)
+            except OSError:
+                pass
+            seen_marker = seen_marker or marker_live
+            if seen_marker and not marker_live and not os.path.exists(marker):
+                # worker 0 finished or died; give the cache one more poll
+                seen_marker = False
             waited = _time.monotonic() - start
+            if seen_marker and not marker_live and waited > grace:
+                # marker exists but has gone stale: worker 0 was killed
+                # mid-computation (its finally never unlinked the marker)
+                if fallback is not None:
+                    logging.warning(
+                        "ImageRecordIter: mean-image marker %r is stale "
+                        "(no mtime update for >%.0fs) — the part_index=0 "
+                        "worker appears dead; computing the mean locally",
+                        marker, stale_after)
+                    return fallback()
+                raise MXNetError(
+                    f"mean image marker {marker!r} is stale — the "
+                    "part_index=0 worker appears to have died while "
+                    "computing; restart it or remove the marker")
             if not seen_marker and waited > grace:
                 if fallback is not None:
                     logging.warning(

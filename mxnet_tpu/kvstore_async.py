@@ -121,8 +121,24 @@ def _encode_msg(obj):
 
 
 def _send_msg(sock, obj):
-    for piece in _encode_msg(obj):
-        sock.sendall(piece)
+    # Gather-send all pieces in one syscall where possible so the strict
+    # request-response protocol never leaves a tiny length/header segment
+    # waiting on Nagle/delayed-ACK (TCP_NODELAY is also set on every
+    # socket at connect/accept for the same reason). Tensor buffers stay
+    # zero-copy; partial sends trim the piece list and retry.
+    pieces = [memoryview(p).cast("B") for p in _encode_msg(obj)]
+    if not hasattr(sock, "sendmsg"):  # pragma: no cover - always on Linux
+        sock.sendall(b"".join(pieces))
+        return
+    while pieces:
+        sent = sock.sendmsg(pieces)
+        while sent:
+            if sent >= pieces[0].nbytes:
+                sent -= pieces[0].nbytes
+                pieces.pop(0)
+            else:
+                pieces[0] = pieces[0][sent:]
+                sent = 0
 
 
 def _recv_exact(sock, n):
@@ -182,6 +198,7 @@ class _AsyncServer:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             if _recv_exact(conn, 4) != _MAGIC:
                 conn.close()
                 continue
@@ -348,6 +365,7 @@ class AsyncKVStore(KVStore):
         while True:
             try:
                 sock = socket.create_connection((host, port), timeout=5.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 sock.sendall(_MAGIC)
                 if _recv_exact(sock, 4) == _MAGIC:
                     sock.settimeout(None)

@@ -171,6 +171,20 @@ class FeedForward(BASE_ESTIMATOR):
         self._pred_fns = {}
         self._eval_fns = {}
 
+    # -- pickling (reference behavior: notebooks pickle whole models) ---------
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        # compiled-step caches hold jitted closures; rebuilt lazily on use
+        state["_pred_fns"] = {}
+        state["_eval_fns"] = {}
+        state.pop("_optimizer_obj", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._pred_fns = {}
+        self._eval_fns = {}
+
     # -- parameter init -------------------------------------------------------
     def _init_params(self, input_shapes, overwrite=False):
         """Infer shapes and run the initializer (reference: model.py:556-569)."""

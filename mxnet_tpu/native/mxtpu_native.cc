@@ -22,6 +22,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -149,6 +150,10 @@ class ImagePipeline {
   ImagePipeline(const char* path, const int64_t* offsets, int64_t n,
                 const PipelineConfig& cfg)
       : cfg_(cfg), offsets_(offsets, offsets + n) {
+    const char* skip = getenv("MXTPU_NATIVE_SKIP_DECODE");
+    skip_decode_ = skip && skip[0] == '1';
+    const char* skipw = getenv("MXTPU_NATIVE_SKIP_WORK");
+    skip_work_ = skipw && skipw[0] == '1';
     fd_ = open(path, O_RDONLY);
     ok_ = fd_ >= 0;
     epoch_ = 0;
@@ -271,6 +276,9 @@ class ImagePipeline {
     int64_t n = order_.size();
     int64_t start = ticket * B;
     out->pad = int(std::max<int64_t>(0, start + B - n));
+    if (skip_work_) return true;  // MXTPU_NATIVE_SKIP_WORK=1: deliver zeroed
+    // batches, measuring only the serial path (ticketing + ordered delivery
+    // memcpy in Next()) for the Amdahl floor in tools/bench_io_scaling.py
     std::vector<uint8_t> payload, pixels, resized;
     for (int i = 0; i < B; ++i) {
       int64_t idx = order_[(start + i) % n];
@@ -292,7 +300,18 @@ class ImagePipeline {
         label_dst[0] = ir.label;
       }
       int h, w;
-      if (!DecodeJpeg(img, img_len, &pixels, &h, &w)) return false;
+      if (skip_decode_) {
+        // Debug mode (MXTPU_NATIVE_SKIP_DECODE=1): substitute the JPEG
+        // decode with a constant-fill of the same nominal geometry, keeping
+        // every other stage (record read, CRC, resize, crop, mirror, batch
+        // assembly, delivery) live. tools/bench_io_scaling.py uses this to
+        // measure the pipeline's non-decode cost — the serial floor of the
+        // Amdahl projection published in BENCH_NOTES_r03.md.
+        h = w = std::max({256, cfg_.height, cfg_.width});
+        pixels.assign(size_t(h) * w * 3, img_len ? img[0] : 0);
+      } else if (!DecodeJpeg(img, img_len, &pixels, &h, &w)) {
+        return false;
+      }
       const uint8_t* hwc = pixels.data();
       // resize so the short side is resize_short (or to fit the crop)
       int target_short = cfg_.resize_short;
@@ -349,6 +368,8 @@ class ImagePipeline {
   std::vector<int64_t> order_;
   int fd_ = -1;
   bool ok_ = false;
+  bool skip_decode_ = false;
+  bool skip_work_ = false;
   int epoch_ = 0;
 
   std::mutex mu_;

@@ -248,7 +248,14 @@ class PoolingOp(OpProp):
         kh, kw = self.kernel
         sh, sw = self.stride
         ph, pw = self.pad
-        return (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+        oh, ow = (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+        if oh < 1 or ow < 1:
+            from ..base import MXNetError
+            raise MXNetError(
+                f"Pooling: kernel {self.kernel} with pad {self.pad} exceeds "
+                f"the input spatial extent ({h}, {w}); use global_pool=True "
+                f"for whole-feature-map pooling")
+        return oh, ow
 
     def _spatial(self):
         return (1, 2) if self.layout == "NHWC" else (2, 3)

@@ -100,3 +100,35 @@ def test_debug_str():
     exe.forward()
     s = exe.debug_str()
     assert "fc" in s
+
+
+def test_backward_uses_captured_residuals():
+    """forward(is_train=True)+backward() must not re-run the forward pass:
+    the executor captures VJP residuals in the forward program (reference
+    contract: GraphExecutor::Forward/Backward each run their half once,
+    graph_executor.cc:616-643)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 8), softmax_label=(2,))
+    rng = np.random.RandomState(0)
+    ex.arg_dict["data"][:] = rng.randn(2, 8)
+    ex.arg_dict["fc_weight"][:] = rng.randn(4, 8) * 0.1
+    ex.forward(is_train=True)
+    assert ex._res_ok and ex._res_leaves is not None
+    ex.backward()
+    g_res = ex.grad_dict["fc_weight"].asnumpy().copy()
+
+    # the fallback (fused fwd+bwd recompute) must agree
+    ex2 = net.simple_bind(ctx=mx.cpu(), data=(2, 8), softmax_label=(2,))
+    ex2._res_ok = False
+    for k in ("data", "fc_weight"):
+        ex.arg_dict[k].copyto(ex2.arg_dict[k])
+    ex2.forward(is_train=True)
+    assert ex2._res_leaves is None
+    ex2.backward()
+    assert np.allclose(g_res, ex2.grad_dict["fc_weight"].asnumpy(), atol=1e-5)
