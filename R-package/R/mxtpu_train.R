@@ -1,0 +1,326 @@
+# R training layer over the flat C API (reference capability:
+# R-package/R/{ndarray,symbol,executor,model}.R — mx.nd.array, mx.symbol.*,
+# mx.model.FeedForward.create). The deployment/inference layer lives in
+# mxtpu.R; this file adds the training surface via the .C shim
+# src/mxtpu_r_train.cc -> libmxtpu_capi (embedded CPython runtime).
+#
+# Load order: dyn.load("src/libmxtpu_r_train.so") with PYTHONPATH pointing
+# at the repo root (the embedded interpreter must import mxnet_tpu).
+# See demo/lenet_train.R for the end-to-end walkthrough.
+
+.mxr.status <- function(r) {
+  if (r$status != 0) {
+    buf <- paste(rep(" ", 2048), collapse = "")
+    e <- .C("mxr_last_error", msg = as.character(buf), as.integer(2048))
+    stop("mxtpu: ", e$msg)
+  }
+  r
+}
+
+mx.r.seed <- function(seed) {
+  invisible(.mxr.status(.C("mxr_random_seed", as.integer(seed),
+                           status = integer(1))))
+}
+
+# ------------------------------------------------------------------ NDArray
+
+mx.nd.array <- function(data) {
+  # R arrays are column-major; the runtime is row-major. aperm the data,
+  # keep the LOGICAL dims (same convention as mxtpu.R's predictor layer).
+  dims <- dim(data)
+  if (is.null(dims)) dims <- length(data)
+  r <- .mxr.status(.C("mxr_nd_create", as.integer(dims),
+                      as.integer(length(dims)), id = integer(1),
+                      status = integer(1)))
+  h <- structure(r$id, class = "mxtpu.ndarray", dims = dims)
+  rowmajor <- aperm(array(data, dims), rev(seq_along(dims)))
+  .mxr.status(.C("mxr_nd_set", as.integer(h), as.double(rowmajor),
+                 as.integer(length(rowmajor)), status = integer(1)))
+  h
+}
+
+mx.nd.zeros <- function(shape) mx.nd.array(array(0, dim = shape))
+
+mx.nd.shape <- function(h) {
+  r <- .mxr.status(.C("mxr_nd_shape", as.integer(h), ndim = integer(1),
+                      shape = integer(8), status = integer(1)))
+  r$shape[seq_len(r$ndim)]
+}
+
+as.array.mxtpu.ndarray <- function(x, ...) {
+  shape <- mx.nd.shape(x)          # row-major dims
+  n <- prod(shape)
+  r <- .mxr.status(.C("mxr_nd_get", as.integer(x), data = double(n),
+                      as.integer(n), status = integer(1)))
+  # back to column-major R array with the logical dims
+  aperm(array(r$data, dim = rev(shape)), rev(seq_along(shape)))
+}
+
+mx.nd.set <- function(h, data) {
+  dims <- dim(data)
+  if (is.null(dims)) dims <- length(data)
+  rowmajor <- aperm(array(data, dims), rev(seq_along(dims)))
+  invisible(.mxr.status(.C("mxr_nd_set", as.integer(h), as.double(rowmajor),
+                           as.integer(length(rowmajor)),
+                           status = integer(1))))
+}
+
+mx.nd.free <- function(h) {
+  invisible(.C("mxr_nd_free", as.integer(h), status = integer(1)))
+}
+
+# ------------------------------------------------------------------- Symbol
+
+mx.symbol.Variable <- function(name) {
+  r <- .mxr.status(.C("mxr_sym_variable", as.character(name),
+                      id = integer(1), status = integer(1)))
+  structure(r$id, class = "mxtpu.symbol")
+}
+
+# generic operator constructor: mx.symbol.op("FullyConnected",
+#   data = prev_symbol, num_hidden = 10, name = "fc1")
+mx.symbol.op <- function(opname, ..., name = "") {
+  all_args <- list(...)
+  is_sym <- vapply(all_args, inherits, logical(1), "mxtpu.symbol")
+  params <- all_args[!is_sym]
+  inputs <- all_args[is_sym]
+  r <- .mxr.status(.C("mxr_sym_atomic", as.character(opname),
+                      as.integer(length(params)),
+                      as.character(names(params)),
+                      as.character(vapply(params, function(p)
+                        paste0(as.character(p), collapse = ","),
+                        character(1))),
+                      id = integer(1), status = integer(1)))
+  sym <- structure(r$id, class = "mxtpu.symbol")
+  .mxr.status(.C("mxr_sym_compose", as.integer(sym), as.character(name),
+                 as.integer(length(inputs)), as.character(names(inputs)),
+                 as.integer(unlist(inputs)), status = integer(1)))
+  sym
+}
+
+mx.symbol.FullyConnected <- function(...) mx.symbol.op("FullyConnected", ...)
+mx.symbol.Activation <- function(...) mx.symbol.op("Activation", ...)
+mx.symbol.Convolution <- function(...) mx.symbol.op("Convolution", ...)
+mx.symbol.Pooling <- function(...) mx.symbol.op("Pooling", ...)
+mx.symbol.Flatten <- function(...) mx.symbol.op("Flatten", ...)
+mx.symbol.BatchNorm <- function(...) mx.symbol.op("BatchNorm", ...)
+mx.symbol.SoftmaxOutput <- function(...) mx.symbol.op("SoftmaxOutput", ...)
+
+mx.symbol.arguments <- function(sym) {
+  buf <- paste(rep(" ", 1 << 16), collapse = "")
+  r <- .mxr.status(.C("mxr_sym_arguments", as.integer(sym),
+                      out = as.character(buf), as.integer(1 << 16),
+                      status = integer(1)))
+  strsplit(r$out, "\n")[[1]]
+}
+
+mx.symbol.aux <- function(sym) {
+  buf <- paste(rep(" ", 1 << 16), collapse = "")
+  r <- .mxr.status(.C("mxr_sym_aux", as.integer(sym),
+                      out = as.character(buf), as.integer(1 << 16),
+                      status = integer(1)))
+  out <- strsplit(r$out, "\n")[[1]]
+  out[nchar(out) > 0]
+}
+
+mx.symbol.tojson <- function(sym) {
+  buf <- paste(rep(" ", 1 << 20), collapse = "")
+  r <- .mxr.status(.C("mxr_sym_tojson", as.integer(sym),
+                      out = as.character(buf), as.integer(1 << 20),
+                      status = integer(1)))
+  r$out
+}
+
+mx.symbol.fromjson <- function(js) {
+  r <- .mxr.status(.C("mxr_sym_fromjson", as.character(js), id = integer(1),
+                      status = integer(1)))
+  structure(r$id, class = "mxtpu.symbol")
+}
+
+mx.symbol.infer.shapes <- function(sym, data_shape, data_name = "data") {
+  max_args <- 256
+  r <- .mxr.status(.C("mxr_sym_infer_shapes", as.integer(sym),
+                      as.character(data_name), as.integer(data_shape),
+                      as.integer(length(data_shape)),
+                      n_args = integer(1), arg_ndims = integer(max_args),
+                      arg_shapes = integer(max_args * 8),
+                      n_aux = integer(1), aux_ndims = integer(max_args),
+                      aux_shapes = integer(max_args * 8),
+                      status = integer(1)))
+  get_shapes <- function(n, ndims, shapes) {
+    lapply(seq_len(n), function(i)
+      shapes[((i - 1) * 8 + 1):((i - 1) * 8 + ndims[i])])
+  }
+  list(arg_shapes = get_shapes(r$n_args, r$arg_ndims, r$arg_shapes),
+       aux_shapes = get_shapes(r$n_aux, r$aux_ndims, r$aux_shapes))
+}
+
+# ----------------------------------------------------------------- Executor
+
+mx.executor.bind <- function(sym, arg_ids, grad_ids, reqs, aux_ids) {
+  r <- .mxr.status(.C("mxr_exec_bind", as.integer(sym),
+                      as.integer(length(arg_ids)), as.integer(arg_ids),
+                      as.integer(grad_ids), as.integer(reqs),
+                      as.integer(length(aux_ids)), as.integer(aux_ids),
+                      id = integer(1), status = integer(1)))
+  structure(r$id, class = "mxtpu.executor")
+}
+
+mx.executor.forward <- function(ex, is.train = FALSE) {
+  invisible(.mxr.status(.C("mxr_exec_forward", as.integer(ex),
+                           as.integer(is.train), status = integer(1))))
+}
+
+mx.executor.backward <- function(ex) {
+  invisible(.mxr.status(.C("mxr_exec_backward", as.integer(ex),
+                           status = integer(1))))
+}
+
+mx.executor.outputs <- function(ex) {
+  r <- .mxr.status(.C("mxr_exec_outputs", as.integer(ex),
+                      ids = integer(64), n = integer(1),
+                      status = integer(1)))
+  lapply(seq_len(r$n), function(i)
+    structure(r$ids[i], class = "mxtpu.ndarray"))
+}
+
+# -------------------------------------------------------------- FeedForward
+
+# mx.model.FeedForward.create: train `symbol` on X (array, R dim order with
+# the sample axis LAST, e.g. 28x28x1xN) / y (labels), plain SGD + momentum.
+# Reference: R-package/R/model.R mx.model.FeedForward.create.
+mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
+                                        num.round = 10, learning.rate = 0.1,
+                                        momentum = 0.9, wd = 0,
+                                        initializer.scale = 0.1,
+                                        verbose = TRUE) {
+  nd <- length(dim(X))
+  n <- dim(X)[nd]
+  data_shape <- c(batch.size, rev(dim(X)[-nd]))  # row-major (N, ...)
+
+  arg_names <- mx.symbol.arguments(symbol)
+  shapes <- mx.symbol.infer.shapes(symbol, data_shape)
+
+  args <- integer(length(arg_names))
+  grads <- integer(length(arg_names))
+  reqs <- integer(length(arg_names))
+  moms <- list()
+  set.seed(0)
+  for (i in seq_along(arg_names)) {
+    shp <- shapes$arg_shapes[[i]]
+    r <- .mxr.status(.C("mxr_nd_create", as.integer(shp),
+                        as.integer(length(shp)), id = integer(1),
+                        status = integer(1)))
+    args[i] <- r$id
+    nm <- arg_names[i]
+    nel <- prod(shp)
+    init <- if (grepl("weight", nm)) {
+      rnorm(nel) * initializer.scale
+    } else if (grepl("gamma", nm)) {
+      rep(1, nel)   # BatchNorm scale: zero would kill gradient flow
+    } else {
+      rep(0, nel)
+    }
+    .mxr.status(.C("mxr_nd_set", as.integer(args[i]), as.double(init),
+                   as.integer(nel), status = integer(1)))
+    if (nm %in% c("data") || grepl("label", nm)) {
+      grads[i] <- 0L
+      reqs[i] <- 0L
+    } else {
+      g <- .mxr.status(.C("mxr_nd_create", as.integer(shp),
+                          as.integer(length(shp)), id = integer(1),
+                          status = integer(1)))
+      grads[i] <- g$id
+      reqs[i] <- 1L
+      moms[[nm]] <- rep(0, nel)
+    }
+  }
+  aux_names <- mx.symbol.aux(symbol)
+  auxs <- integer(0)
+  if (length(aux_names) > 0) {
+    auxs <- vapply(seq_along(aux_names), function(i) {
+      shp <- shapes$aux_shapes[[i]]
+      r <- .mxr.status(.C("mxr_nd_create", as.integer(shp),
+                          as.integer(length(shp)), id = integer(1),
+                          status = integer(1)))
+      init <- if (grepl("var", aux_names[i])) rep(1, prod(shp))
+              else rep(0, prod(shp))
+      .mxr.status(.C("mxr_nd_set", as.integer(r$id), as.double(init),
+                     as.integer(prod(shp)), status = integer(1)))
+      r$id
+    }, integer(1))
+  }
+
+  ex <- mx.executor.bind(symbol, args, grads, reqs, auxs)
+  data_idx <- which(arg_names == "data")
+  label_idx <- which(grepl("label", arg_names))
+
+  Xflat <- array(X, dim = c(prod(dim(X)[-nd]), n))  # features x N
+  for (round in seq_len(num.round)) {
+    correct <- 0
+    seen <- 0
+    for (start in seq(1, n - batch.size + 1, by = batch.size)) {
+      idx <- start:(start + batch.size - 1)
+      # row-major batch: sample-major ordering
+      batch <- t(Xflat[, idx])
+      .mxr.status(.C("mxr_nd_set", as.integer(args[data_idx]),
+                     as.double(t(batch)), as.integer(length(batch)),
+                     status = integer(1)))
+      .mxr.status(.C("mxr_nd_set", as.integer(args[label_idx]),
+                     as.double(y[idx]), as.integer(batch.size),
+                     status = integer(1)))
+      mx.executor.forward(ex, is.train = TRUE)
+      outs <- mx.executor.outputs(ex)
+      prob <- as.array.mxtpu.ndarray(outs[[1]])
+      pred <- max.col(t(prob)) - 1  # prob is classes x batch in R order
+      correct <- correct + sum(pred == y[idx])
+      seen <- seen + batch.size
+      for (o in outs) mx.nd.free(o)
+      mx.executor.backward(ex)
+      for (i in seq_along(arg_names)) {
+        if (reqs[i] == 0) next
+        nm <- arg_names[i]
+        nel <- length(moms[[nm]])
+        g <- .mxr.status(.C("mxr_nd_get", as.integer(grads[i]),
+                            data = double(nel), as.integer(nel),
+                            status = integer(1)))$data
+        w <- .mxr.status(.C("mxr_nd_get", as.integer(args[i]),
+                            data = double(nel), as.integer(nel),
+                            status = integer(1)))$data
+        moms[[nm]] <- momentum * moms[[nm]] +
+          (g / batch.size + wd * w)
+        w <- w - learning.rate * moms[[nm]]
+        .mxr.status(.C("mxr_nd_set", as.integer(args[i]), as.double(w),
+                       as.integer(nel), status = integer(1)))
+      }
+    }
+    if (verbose)
+      message(sprintf("Round [%d] train accuracy: %.4f", round,
+                      correct / seen))
+  }
+  structure(list(executor = ex, arg_names = arg_names, args = args,
+                 symbol = symbol, train_acc = correct / seen),
+            class = "mxtpu.model")
+}
+
+# forward-only prediction on a trained model (batch.size must divide N)
+mx.model.predict <- function(model, X, batch.size = 32) {
+  nd <- length(dim(X))
+  n <- dim(X)[nd]
+  Xflat <- array(X, dim = c(prod(dim(X)[-nd]), n))
+  data_idx <- which(model$arg_names == "data")
+  preds <- NULL
+  for (start in seq(1, n - batch.size + 1, by = batch.size)) {
+    idx <- start:(start + batch.size - 1)
+    batch <- t(Xflat[, idx])
+    .mxr.status(.C("mxr_nd_set", as.integer(model$args[data_idx]),
+                   as.double(t(batch)), as.integer(length(batch)),
+                   status = integer(1)))
+    mx.executor.forward(model$executor, is.train = FALSE)
+    outs <- mx.executor.outputs(model$executor)
+    prob <- as.array.mxtpu.ndarray(outs[[1]])
+    for (o in outs) mx.nd.free(o)
+    preds <- cbind(preds, prob)
+  }
+  preds
+}

@@ -1,0 +1,267 @@
+// R training shim: .C-convention wrappers over the flat C API
+// (mxnet_tpu/native/mxtpu_c_api.h).
+//
+// Reference counterpart: R-package/src/*.cc (Rcpp bindings over
+// include/mxnet/c_api.h). R's .C interface passes everything as pointers
+// to basic types and copies vectors, so handles cross as integer ids into
+// a process-local table, strings as char**, and tensors as double* (R has
+// no float; converted at the boundary).
+//
+// Build (needs libmxtpu_capi.so next to it or on LD_LIBRARY_PATH):
+//   make -C ../mxnet_tpu/native capi
+//   R CMD SHLIB mxtpu_r_train.cc -L../mxnet_tpu/native -lmxtpu_capi
+// The same entry points are also exercised without R by
+// tests/test_r_binding.py through ctypes using the identical pointer
+// calling convention.
+
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "../../mxnet_tpu/native/mxtpu_c_api.h"
+
+namespace {
+
+std::map<int, void*> g_handles;
+int g_next_id = 1;
+std::string g_last_error;
+
+int put_handle(void* h) {
+  int id = g_next_id++;
+  g_handles[id] = h;
+  return id;
+}
+
+void* get_handle(int id) {
+  auto it = g_handles.find(id);
+  return it == g_handles.end() ? nullptr : it->second;
+}
+
+int record(int rc) {
+  if (rc != 0) g_last_error = MXGetLastError();
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+void mxr_last_error(char** msg, int* len) {
+  std::strncpy(*msg, g_last_error.c_str(), *len - 1);
+  (*msg)[*len - 1] = '\0';
+}
+
+void mxr_random_seed(int* seed, int* status) {
+  *status = record(MXRandomSeed(*seed));
+}
+
+/* ------------------------------------------------------------- ndarray */
+
+void mxr_nd_create(int* shape, int* ndim, int* id_out, int* status) {
+  std::vector<mx_uint> s(shape, shape + *ndim);
+  NDArrayHandle h;
+  *status = record(MXNDArrayCreate(s.data(), *ndim, 1, 0, 0, &h));
+  if (*status == 0) *id_out = put_handle(h);
+}
+
+void mxr_nd_free(int* id, int* status) {
+  void* h = get_handle(*id);
+  g_handles.erase(*id);
+  *status = record(MXNDArrayFree(h));
+}
+
+void mxr_nd_shape(int* id, int* ndim_out, int* shape_out, int* status) {
+  mx_uint nd;
+  const mx_uint* dims;
+  *status = record(MXNDArrayGetShape(get_handle(*id), &nd, &dims));
+  if (*status != 0) return;
+  *ndim_out = (int)nd;
+  for (mx_uint i = 0; i < nd && i < 8; ++i) shape_out[i] = (int)dims[i];
+}
+
+void mxr_nd_set(int* id, double* data, int* n, int* status) {
+  std::vector<float> buf(*n);
+  for (int i = 0; i < *n; ++i) buf[i] = (float)data[i];
+  *status = record(
+      MXNDArraySyncCopyFromCPU(get_handle(*id), buf.data(), *n));
+}
+
+void mxr_nd_get(int* id, double* data, int* n, int* status) {
+  std::vector<float> buf(*n);
+  *status = record(MXNDArraySyncCopyToCPU(get_handle(*id), buf.data(), *n));
+  if (*status != 0) return;
+  for (int i = 0; i < *n; ++i) data[i] = buf[i];
+}
+
+/* ------------------------------------------------------------- symbols */
+
+void mxr_sym_variable(char** name, int* id_out, int* status) {
+  SymbolHandle h;
+  *status = record(MXSymbolCreateVariable(name[0], &h));
+  if (*status == 0) *id_out = put_handle(h);
+}
+
+void mxr_sym_atomic(char** opname, int* nparam, char** keys, char** vals,
+                    int* id_out, int* status) {
+  // enumerate the registry once and cache name -> creator: creator handles
+  // from MXSymbolListAtomicSymbolCreators are owned allocations, so
+  // re-listing per symbol would both leak them and cost O(#ops) embedded
+  // Python round-trips for every layer an R model builds
+  static std::map<std::string, AtomicSymbolCreator> creator_cache;
+  if (creator_cache.empty()) {
+    mx_uint n_creators;
+    AtomicSymbolCreator* creators;
+    *status = record(MXSymbolListAtomicSymbolCreators(&n_creators,
+                                                      &creators));
+    if (*status != 0) return;
+    for (mx_uint i = 0; i < n_creators; ++i) {
+      const char *nm, *desc, *kv;
+      mx_uint na;
+      const char **an, **at, **ad;
+      if (MXSymbolGetAtomicSymbolInfo(creators[i], &nm, &desc, &na, &an,
+                                      &at, &ad, &kv) != 0)
+        continue;
+      creator_cache[nm] = creators[i];
+    }
+  }
+  auto it = creator_cache.find(opname[0]);
+  if (it == creator_cache.end()) {
+    g_last_error = std::string("unknown operator ") + opname[0];
+    *status = -1;
+    return;
+  }
+  AtomicSymbolCreator target = it->second;
+  std::vector<const char*> k(*nparam), v(*nparam);
+  for (int i = 0; i < *nparam; ++i) {
+    k[i] = keys[i];
+    v[i] = vals[i];
+  }
+  SymbolHandle h;
+  *status = record(MXSymbolCreateAtomicSymbol(target, *nparam, k.data(),
+                                              v.data(), &h));
+  if (*status == 0) *id_out = put_handle(h);
+}
+
+void mxr_sym_compose(int* sym_id, char** name, int* nargs, char** keys,
+                     int* arg_ids, int* status) {
+  std::vector<const char*> k(*nargs);
+  std::vector<SymbolHandle> args(*nargs);
+  for (int i = 0; i < *nargs; ++i) {
+    k[i] = keys[i];
+    args[i] = get_handle(arg_ids[i]);
+  }
+  *status = record(MXSymbolCompose(get_handle(*sym_id), name[0], *nargs,
+                                   k.data(), args.data()));
+}
+
+// joined with '\n' into the caller's buffer (R-friendly string return)
+static void join_list(mx_uint n, const char** arr, char** out, int* cap) {
+  std::string joined;
+  for (mx_uint i = 0; i < n; ++i) {
+    if (i) joined += '\n';
+    joined += arr[i];
+  }
+  std::strncpy(*out, joined.c_str(), *cap - 1);
+  (*out)[*cap - 1] = '\0';
+}
+
+void mxr_sym_arguments(int* id, char** out, int* cap, int* status) {
+  mx_uint n;
+  const char** names;
+  *status = record(MXSymbolListArguments(get_handle(*id), &n, &names));
+  if (*status == 0) join_list(n, names, out, cap);
+}
+
+void mxr_sym_aux(int* id, char** out, int* cap, int* status) {
+  mx_uint n;
+  const char** names;
+  *status =
+      record(MXSymbolListAuxiliaryStates(get_handle(*id), &n, &names));
+  if (*status == 0) join_list(n, names, out, cap);
+}
+
+void mxr_sym_tojson(int* id, char** out, int* cap, int* status) {
+  const char* js;
+  *status = record(MXSymbolSaveToJSON(get_handle(*id), &js));
+  if (*status != 0) return;
+  std::strncpy(*out, js, *cap - 1);
+  (*out)[*cap - 1] = '\0';
+}
+
+void mxr_sym_fromjson(char** js, int* id_out, int* status) {
+  SymbolHandle h;
+  *status = record(MXSymbolCreateFromJSON(js[0], &h));
+  if (*status == 0) *id_out = put_handle(h);
+}
+
+// infer shapes given data shape; writes ndim+dims per argument
+// (flattened, 8 slots per arg) and the same for aux states
+void mxr_sym_infer_shapes(int* id, char** data_name, int* data_shape,
+                          int* data_ndim, int* n_args_out, int* arg_ndims,
+                          int* arg_shapes, int* n_aux_out, int* aux_ndims,
+                          int* aux_shapes, int* status) {
+  const char* keys[1] = {data_name[0]};
+  mx_uint ind[2] = {0, (mx_uint)*data_ndim};
+  std::vector<mx_uint> shp(*data_ndim);
+  for (int i = 0; i < *data_ndim; ++i) shp[i] = data_shape[i];
+  mx_uint in_n, out_n, aux_n;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_d, **out_d, **aux_d;
+  int complete;
+  *status = record(MXSymbolInferShape(
+      get_handle(*id), 1, keys, ind, shp.data(), &in_n, &in_nd, &in_d,
+      &out_n, &out_nd, &out_d, &aux_n, &aux_nd, &aux_d, &complete));
+  if (*status != 0) return;
+  *n_args_out = (int)in_n;
+  for (mx_uint i = 0; i < in_n; ++i) {
+    arg_ndims[i] = (int)in_nd[i];
+    for (mx_uint j = 0; j < in_nd[i] && j < 8; ++j)
+      arg_shapes[i * 8 + j] = (int)in_d[i][j];
+  }
+  *n_aux_out = (int)aux_n;
+  for (mx_uint i = 0; i < aux_n; ++i) {
+    aux_ndims[i] = (int)aux_nd[i];
+    for (mx_uint j = 0; j < aux_nd[i] && j < 8; ++j)
+      aux_shapes[i * 8 + j] = (int)aux_d[i][j];
+  }
+}
+
+/* ------------------------------------------------------------ executor */
+
+void mxr_exec_bind(int* sym_id, int* n, int* arg_ids, int* grad_ids,
+                   int* reqs, int* naux, int* aux_ids, int* id_out,
+                   int* status) {
+  std::vector<NDArrayHandle> args(*n), grads(*n), aux(*naux);
+  std::vector<mx_uint> req(*n);
+  for (int i = 0; i < *n; ++i) {
+    args[i] = get_handle(arg_ids[i]);
+    grads[i] = grad_ids[i] > 0 ? get_handle(grad_ids[i]) : nullptr;
+    req[i] = (mx_uint)reqs[i];
+  }
+  for (int i = 0; i < *naux; ++i) aux[i] = get_handle(aux_ids[i]);
+  ExecutorHandle h;
+  *status = record(MXExecutorBind(get_handle(*sym_id), 1, 0, *n, args.data(),
+                                  grads.data(), req.data(), *naux,
+                                  aux.data(), &h));
+  if (*status == 0) *id_out = put_handle(h);
+}
+
+void mxr_exec_forward(int* id, int* is_train, int* status) {
+  *status = record(MXExecutorForward(get_handle(*id), *is_train));
+}
+
+void mxr_exec_backward(int* id, int* status) {
+  *status = record(MXExecutorBackward(get_handle(*id), 0, nullptr));
+}
+
+void mxr_exec_outputs(int* id, int* ids_out, int* n_out, int* status) {
+  mx_uint n;
+  NDArrayHandle* outs;
+  *status = record(MXExecutorOutputs(get_handle(*id), &n, &outs));
+  if (*status != 0) return;
+  *n_out = (int)n;
+  for (mx_uint i = 0; i < n && i < 64; ++i) ids_out[i] = put_handle(outs[i]);
+}
+
+}  // extern "C"

@@ -1,0 +1,429 @@
+"""Python side of the flat C API (reference: include/mxnet/c_api.h, 79
+``MX*`` functions implemented in src/c_api/c_api.cc:96-1069).
+
+Architecture: the reference's C API wraps a C++ core; ours wraps the JAX
+core, so the C library (native/mxtpu_capi.cc) embeds CPython and forwards
+every call here. Handles crossing the C boundary ARE PyObject pointers
+(NDArray / Symbol / Executor / iterator / KVStore / recordio objects) —
+the C layer owns one reference per live handle and this module never sees
+raw pointers except for caller-owned data buffers, which arrive as
+integer addresses and are touched only through ctypes.
+
+Everything returns plain Python scalars/tuples/lists/bytes so the C glue
+stays uniform. Exceptions propagate to C, which formats them into the
+thread-local MXGetLastError buffer and returns -1, exactly like the
+reference's API_BEGIN/API_END macros (src/c_api/c_api_error.h).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import io as io_mod
+from . import ndarray as nd
+from . import random as random_mod
+from . import recordio as rio
+from . import symbol as sym_mod
+from .base import MXNetError
+from .context import Context, cpu, tpu
+from .executor import Executor
+from .kvstore import create as kv_create_fn
+from .ndarray import NDArray
+from .ops.registry import OPS
+from .symbol import Symbol
+
+__all__ = ["CApi"]
+
+
+def _ctx(dev_type: int, dev_id: int) -> Context:
+    # reference dev_type: 1=cpu, 2=gpu, 3=cpu_pinned (base.h:92-97);
+    # the accelerator slot maps to tpu here
+    return cpu(dev_id) if dev_type in (1, 3) else tpu(dev_id)
+
+
+# the reference's 18 registered NDArray functions (ndarray.cc:601-652)
+# plus the unary TBlob ops, with their FFI arity metadata
+# (num_use_vars, num_scalars, num_mutate_vars, accept_empty_mutate)
+_FUNCTIONS: dict = {
+    "_set_value": (0, 1, 1, False),
+    "_plus": (2, 0, 1, True),
+    "_minus": (2, 0, 1, True),
+    "_mul": (2, 0, 1, True),
+    "_div": (2, 0, 1, True),
+    "dot": (2, 0, 1, True),
+    "_onehot_encode": (2, 0, 1, False),
+    "choose_element_0index": (2, 0, 1, True),
+    "_plus_scalar": (1, 1, 1, True),
+    "_minus_scalar": (1, 1, 1, True),
+    "_mul_scalar": (1, 1, 1, True),
+    "_div_scalar": (1, 1, 1, True),
+    "_rminus_scalar": (1, 1, 1, True),
+    "_rdiv_scalar": (1, 1, 1, True),
+    "_copyto": (1, 0, 1, False),
+    "_random_uniform": (0, 2, 1, False),
+    "_random_gaussian": (0, 2, 1, False),
+    "clip": (1, 2, 1, True),
+    "square": (1, 0, 1, True),
+    "sqrt": (1, 0, 1, True),
+    "exp": (1, 0, 1, True),
+    "log": (1, 0, 1, True),
+    "norm": (1, 0, 1, True),
+}
+
+
+class CApi:
+    """Instance methods = the C API, one per MX* entry point."""
+
+    # -- ndarray ------------------------------------------------------------
+    def ndarray_create_none(self):
+        return NDArray(np.zeros((1,), np.float32))
+
+    def ndarray_create(self, shape, dev_type, dev_id, delay_alloc):
+        return nd.zeros(tuple(int(s) for s in shape), _ctx(dev_type, dev_id))
+
+    def ndarray_save(self, fname, handles, names):
+        if names:
+            nd.save(fname, dict(zip(names, handles)))
+        else:
+            nd.save(fname, list(handles))
+
+    def ndarray_load(self, fname):
+        loaded = nd.load(fname)
+        if isinstance(loaded, dict):
+            names = list(loaded.keys())
+            return list(loaded.values()), names
+        return list(loaded), []
+
+    def ndarray_save_raw(self, array) -> bytes:
+        a = array.asnumpy().astype(np.float32)
+        shape = np.asarray(a.shape, np.int64)
+        return (np.asarray([len(a.shape)], np.int64).tobytes()
+                + shape.tobytes() + a.tobytes())
+
+    def ndarray_load_raw(self, buf: bytes):
+        ndim = int(np.frombuffer(buf[:8], np.int64)[0])
+        shape = tuple(np.frombuffer(buf[8:8 + 8 * ndim], np.int64).tolist())
+        data = np.frombuffer(buf[8 + 8 * ndim:], np.float32).reshape(shape)
+        return NDArray(data.copy())
+
+    def ndarray_sync_copy_from(self, array, src_addr, size):
+        src = np.ctypeslib.as_array(
+            (ctypes.c_float * int(size)).from_address(int(src_addr)))
+        array[:] = src.reshape(array.shape).copy()
+
+    def ndarray_sync_copy_to(self, array, dst_addr, size):
+        host = np.ascontiguousarray(array.asnumpy().astype(np.float32))
+        if host.size != int(size):
+            raise MXNetError(
+                f"SyncCopyToCPU: destination holds {size} floats, array "
+                f"has {host.size}")
+        ctypes.memmove(int(dst_addr), host.ctypes.data, host.nbytes)
+
+    def ndarray_wait_to_read(self, array):
+        array.wait_to_read()
+
+    def ndarray_wait_all(self):
+        from .engine import engine
+
+        engine().wait_for_all()
+
+    def ndarray_slice(self, array, lo, hi):
+        return array[int(lo):int(hi)]
+
+    def ndarray_shape(self, array):
+        return tuple(int(s) for s in array.shape)
+
+    def ndarray_data_ptr(self, array):
+        # keep the host mirror alive on the wrapper, reference returns a
+        # pointer into the CPU tensor (c_api.cc MXNDArrayGetData)
+        host = np.ascontiguousarray(array.asnumpy().astype(np.float32))
+        array._capi_host_view = host
+        return host.ctypes.data
+
+    def ndarray_context(self, array):
+        c = array.context
+        return (1 if c.device_type == "cpu" else 2), c.device_id
+
+    # -- registered functions ------------------------------------------------
+    def list_functions(self):
+        return [f for f in _FUNCTIONS if hasattr(nd, f) or f == "_set_value"]
+
+    def func_info(self, name):
+        nuse, nscalar, nmutate, accept_empty = _FUNCTIONS[name]
+        fn = getattr(nd, name, None)
+        doc = (fn.__doc__ or "").strip() if fn else ""
+        return name, doc, nuse, nscalar, nmutate
+
+    def func_describe(self, name):
+        return _FUNCTIONS[name][:3] + (1 if _FUNCTIONS[name][3] else 0,)
+
+    def func_invoke(self, name, use_vars, scalars, mutate_vars):
+        if name == "_set_value":
+            mutate_vars[0][:] = float(scalars[0])
+            return
+        if name == "_copyto":
+            use_vars[0].copyto(mutate_vars[0])
+            return
+        if name == "_random_uniform":
+            mutate_vars[0]._set_data(
+                random_mod.uniform(float(scalars[0]), float(scalars[1]),
+                                   mutate_vars[0].shape)._data)
+            return
+        if name == "_random_gaussian":
+            mutate_vars[0]._set_data(
+                random_mod.normal(float(scalars[0]), float(scalars[1]),
+                                  mutate_vars[0].shape)._data)
+            return
+        if name == "_onehot_encode":
+            nd.onehot_encode(use_vars[0], mutate_vars[1] if len(mutate_vars) > 1
+                             else mutate_vars[0])
+            return
+        fn = getattr(nd, name)
+        out = mutate_vars[0] if mutate_vars else None
+        args = list(use_vars) + [float(s) for s in scalars]
+        fn(*args, out=out)
+
+    # -- operators / symbols -------------------------------------------------
+    def list_ops(self):
+        return sorted({cls.op_name for cls in OPS._entries.values()})
+
+    def op_info(self, opname):
+        prop_cls = OPS.get(opname)
+        doc = (prop_cls.__doc__ or "").strip()
+        names, types, descs = [], [], []
+        for pname, spec in getattr(prop_cls, "params", {}).items():
+            names.append(pname)
+            types.append(repr(spec[0]))
+            descs.append(spec[2] if len(spec) > 2 else "")
+        return opname, doc, names, types, descs, ""
+
+    def symbol_create_atomic(self, opname, keys, vals):
+        OPS.get(opname)  # raises for unknown operators
+        return ("__atomic__", opname,
+                {k: self._parse_iter_val(v) for k, v in zip(keys, vals)})
+
+    def symbol_create_variable(self, name):
+        return sym_mod.Variable(name)
+
+    def symbol_create_group(self, symbols):
+        return sym_mod.Group(list(symbols))
+
+    def symbol_from_file(self, fname):
+        return sym_mod.load(fname)
+
+    def symbol_from_json(self, js):
+        return sym_mod.load_json(js)
+
+    def symbol_save_file(self, symbol, fname):
+        symbol.save(fname)
+
+    def symbol_to_json(self, symbol):
+        return symbol.tojson()
+
+    def symbol_copy(self, symbol):
+        return sym_mod.load_json(symbol.tojson())
+
+    def symbol_print(self, symbol):
+        return symbol.debug_str()
+
+    def symbol_list_arguments(self, symbol):
+        return list(symbol.list_arguments())
+
+    def symbol_list_outputs(self, symbol):
+        return list(symbol.list_outputs())
+
+    def symbol_list_aux(self, symbol):
+        return list(symbol.list_auxiliary_states())
+
+    def symbol_get_internals(self, symbol):
+        return symbol.get_internals()
+
+    def symbol_get_output(self, symbol, index):
+        return symbol[int(index)]
+
+    def symbol_compose(self, symbol, name, keys, args):
+        """Reference two-step creation: CreateAtomicSymbol then Compose
+        (c_api.cc MXSymbolCompose). Atomic records compose into a real
+        Symbol; composing an existing symbol re-binds its free variables."""
+        if isinstance(symbol, tuple) and symbol and symbol[0] == "__atomic__":
+            _, opname, params = symbol
+            kwargs = dict(params)
+            if keys:
+                kwargs.update(zip(keys, args))
+                pos = []
+            else:
+                pos = list(args)
+            return sym_mod._create(opname, *pos, name=name or None, **kwargs)
+        raise MXNetError(
+            "MXSymbolCompose on an already-composed symbol is not supported "
+            "in the TPU build: compose at creation (CreateAtomicSymbol + "
+            "Compose) like the reference bindings do")
+
+    def symbol_infer_shape(self, symbol, names, shapes):
+        if isinstance(symbol, tuple) and symbol and symbol[0] == "__atomic__":
+            raise MXNetError("infer_shape requires a composed symbol")
+        kwargs = {n: tuple(int(x) for x in s) for n, s in zip(names, shapes)}
+        try:
+            arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+        except MXNetError:
+            raise
+        if arg_shapes is None:
+            return [], [], [], 0
+        return ([tuple(s) for s in arg_shapes],
+                [tuple(s) for s in out_shapes],
+                [tuple(s) for s in aux_shapes], 1)
+
+    # -- executor ------------------------------------------------------------
+    _GRAD_REQ = {0: "null", 1: "write", 2: "write", 3: "add"}  # 2=inplace
+
+    def executor_bind(self, symbol, dev_type, dev_id, args, grads, reqs, aux):
+        arg_names = symbol.list_arguments()
+        req_map = {n: self._GRAD_REQ[int(r)] for n, r in zip(arg_names, reqs)}
+        grad_map = {n: g for n, g in zip(arg_names, grads) if g is not None}
+        aux_map = dict(zip(symbol.list_auxiliary_states(), aux))
+        return Executor(symbol, _ctx(dev_type, dev_id),
+                        dict(zip(arg_names, args)), grad_map, req_map,
+                        aux_map)
+
+    def executor_forward(self, executor, is_train):
+        executor.forward(is_train=bool(is_train))
+
+    def executor_backward(self, executor, head_grads):
+        executor.backward(list(head_grads) if head_grads else None)
+
+    def executor_outputs(self, executor):
+        return list(executor.outputs)
+
+    def executor_print(self, executor):
+        return executor.debug_str()
+
+    # -- data iterators ------------------------------------------------------
+    _ITERS = ("MNISTIter", "ImageRecordIter", "CSVIter", "NDArrayIter")
+
+    def list_data_iters(self):
+        return [n for n in self._ITERS if hasattr(io_mod, n)]
+
+    def data_iter_create(self, name, keys, vals):
+        cls = getattr(io_mod, name)
+        kwargs = {}
+        for k, v in zip(keys, vals):
+            kwargs[k] = self._parse_iter_val(v)
+        it = cls(**kwargs)
+        it._capi_batch = None
+        return it
+
+    @staticmethod
+    def _parse_iter_val(v):
+        s = str(v)
+        if s.lower() in ("true", "false"):
+            return s.lower() == "true"
+        for conv in (int, float):
+            try:
+                return conv(s)
+            except ValueError:
+                pass
+        if s.startswith("(") and s.endswith(")"):
+            inner = s[1:-1].strip().rstrip(",")
+            if inner:
+                return tuple(int(float(x)) for x in inner.split(","))
+            return ()
+        return s
+
+    def data_iter_next(self, it):
+        try:
+            it._capi_batch = next(it)
+            return 1
+        except StopIteration:
+            it._capi_batch = None
+            return 0
+
+    def data_iter_before_first(self, it):
+        it.reset()
+        it._capi_batch = None
+
+    def data_iter_get_data(self, it):
+        return it._capi_batch.data[0]
+
+    def data_iter_get_label(self, it):
+        return it._capi_batch.label[0]
+
+    def data_iter_get_pad(self, it):
+        return int(it._capi_batch.pad or 0)
+
+    # -- kvstore -------------------------------------------------------------
+    def kv_create(self, kv_type):
+        return kv_create_fn(kv_type)
+
+    def kv_init(self, kv, keys, vals):
+        for k, v in zip(keys, vals):
+            kv.init(int(k), v)
+
+    def kv_push(self, kv, keys, vals, priority):
+        kv.push([int(k) for k in keys], list(vals), priority=int(priority))
+
+    def kv_pull(self, kv, keys, outs, priority):
+        kv.pull([int(k) for k in keys], list(outs), priority=int(priority))
+
+    def kv_set_updater(self, kv, py_updater):
+        kv.set_updater(py_updater)
+
+    def kv_get_type(self, kv):
+        return getattr(kv, "type", getattr(kv, "kv_type", "local"))
+
+    def kv_get_rank(self, kv):
+        return int(kv.rank)
+
+    def kv_get_group_size(self, kv):
+        return int(kv.num_workers)
+
+    def kv_barrier(self, kv):
+        kv.barrier()
+
+    def kv_send_command(self, kv, head, body):
+        kv.send_command_to_servers(int(head), body)
+
+    def kv_is_worker_node(self):
+        import os
+
+        return int(os.environ.get("DMLC_ROLE", "worker") == "worker")
+
+    def kv_is_server_node(self):
+        import os
+
+        return int(os.environ.get("DMLC_ROLE", "worker") == "server")
+
+    def kv_is_scheduler_node(self):
+        import os
+
+        return int(os.environ.get("DMLC_ROLE", "worker") == "scheduler")
+
+    def kv_run_server(self, kv, controller):
+        # in-process group server handles the server role automatically
+        # (kvstore_server.py import-time switch); nothing to pump here
+        return None
+
+    # -- recordio ------------------------------------------------------------
+    def recordio_writer_create(self, uri):
+        return rio.MXRecordIO(uri, "w")
+
+    def recordio_reader_create(self, uri):
+        return rio.MXRecordIO(uri, "r")
+
+    def recordio_close(self, rec):
+        rec.close()
+
+    def recordio_write(self, rec, buf):
+        rec.write(bytes(buf))
+
+    def recordio_read(self, rec):
+        data = rec.read()
+        return data if data is not None else b""
+
+    # -- misc ----------------------------------------------------------------
+    def random_seed(self, seed):
+        random_mod.seed(int(seed))
+
+    def notify_shutdown(self):
+        return None

@@ -385,11 +385,19 @@ class Executor:
         'Total N MB allocated'); reports XLA memory analysis when compiled."""
         lines = [self._symbol.debug_str()]
         fn = self._fwd_fns.get(True) or self._fwd_fns.get(False)
+        compiled = None
+        arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+        aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        rng = jnp.zeros((2,), jnp.uint32)
         if fn is not None:
-            arg_vals = {n: a._data for n, a in self.arg_dict.items()}
-            aux_vals = {n: a._data for n, a in self.aux_dict.items()}
-            rng = jnp.zeros((2,), jnp.uint32)
             compiled = fn.lower(arg_vals, aux_vals, rng).compile()
+        elif self._fwd_res_fn is not None:
+            # train forwards ran through the residual-capture program only
+            diff = {n: arg_vals[n] for n in self._diff_names()}
+            other = {n: v for n, v in arg_vals.items() if n not in diff}
+            compiled = self._fwd_res_fn.lower(diff, other, aux_vals,
+                                              rng).compile()
+        if compiled is not None:
             try:
                 mem = compiled.memory_analysis()
                 total = getattr(mem, "temp_size_in_bytes", 0) + getattr(

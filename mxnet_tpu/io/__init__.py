@@ -474,16 +474,18 @@ class ImageRecordIter(DataIter):
                 pass
         except OSError:
             marker = None
+        import time as _time
+
         acc = np.zeros((th, tw, c), np.float64)
-        last_touch = 0.0
+        last_touch = _time.monotonic()
         with open_uri(self._path, "rb") as f:
-            for i, off in enumerate(offsets):
-                if marker is not None and i % 64 == 0:
+            for off in offsets:
+                if marker is not None:
                     # keep the marker's mtime fresh so waiters can tell a
                     # live computation from a stale marker left by a killed
-                    # run (waiters treat mtime older than ~90s as dead)
-                    import time as _time
-
+                    # run (waiters treat mtime older than ~90s as dead);
+                    # checked every record so even very slow decodes
+                    # (>1s/record) cannot trip the staleness detector
                     now = _time.monotonic()
                     if now - last_touch > 20.0:
                         last_touch = now

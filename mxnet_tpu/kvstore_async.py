@@ -131,7 +131,9 @@ def _send_msg(sock, obj):
         sock.sendall(b"".join(pieces))
         return
     while pieces:
-        sent = sock.sendmsg(pieces)
+        # Linux caps sendmsg at IOV_MAX (1024) iovecs; larger messages
+        # (3 + 2 per tensor) go out in chunks
+        sent = sock.sendmsg(pieces[:1024])
         while sent:
             if sent >= pieces[0].nbytes:
                 sent -= pieces[0].nbytes
