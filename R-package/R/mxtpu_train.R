@@ -107,26 +107,26 @@ mx.symbol.BatchNorm <- function(...) mx.symbol.op("BatchNorm", ...)
 mx.symbol.SoftmaxOutput <- function(...) mx.symbol.op("SoftmaxOutput", ...)
 
 mx.symbol.arguments <- function(sym) {
-  buf <- paste(rep(" ", 1 << 16), collapse = "")
+  buf <- paste(rep(" ", 65536L), collapse = "")
   r <- .mxr.status(.C("mxr_sym_arguments", as.integer(sym),
-                      out = as.character(buf), as.integer(1 << 16),
+                      out = as.character(buf), as.integer(65536L),
                       status = integer(1)))
   strsplit(r$out, "\n")[[1]]
 }
 
 mx.symbol.aux <- function(sym) {
-  buf <- paste(rep(" ", 1 << 16), collapse = "")
+  buf <- paste(rep(" ", 65536L), collapse = "")
   r <- .mxr.status(.C("mxr_sym_aux", as.integer(sym),
-                      out = as.character(buf), as.integer(1 << 16),
+                      out = as.character(buf), as.integer(65536L),
                       status = integer(1)))
   out <- strsplit(r$out, "\n")[[1]]
   out[nchar(out) > 0]
 }
 
 mx.symbol.tojson <- function(sym) {
-  buf <- paste(rep(" ", 1 << 20), collapse = "")
+  buf <- paste(rep(" ", 1048576L), collapse = "")
   r <- .mxr.status(.C("mxr_sym_tojson", as.integer(sym),
-                      out = as.character(buf), as.integer(1 << 20),
+                      out = as.character(buf), as.integer(1048576L),
                       status = integer(1)))
   r$out
 }
@@ -137,11 +137,12 @@ mx.symbol.fromjson <- function(js) {
   structure(r$id, class = "mxtpu.symbol")
 }
 
-mx.symbol.infer.shapes <- function(sym, data_shape, data_name = "data") {
-  max_args <- 256
+mx.symbol.infer.shapes <- function(sym, data_shape, data_name = "data",
+                                   max_args = 1024L) {
   r <- .mxr.status(.C("mxr_sym_infer_shapes", as.integer(sym),
                       as.character(data_name), as.integer(data_shape),
                       as.integer(length(data_shape)),
+                      as.integer(max_args),
                       n_args = integer(1), arg_ndims = integer(max_args),
                       arg_shapes = integer(max_args * 8),
                       n_aux = integer(1), aux_ndims = integer(max_args),
@@ -271,8 +272,8 @@ mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
                      status = integer(1)))
       mx.executor.forward(ex, is.train = TRUE)
       outs <- mx.executor.outputs(ex)
-      prob <- as.array.mxtpu.ndarray(outs[[1]])
-      pred <- max.col(t(prob)) - 1  # prob is classes x batch in R order
+      prob <- as.array.mxtpu.ndarray(outs[[1]])  # batch x classes
+      pred <- max.col(prob) - 1
       correct <- correct + sum(pred == y[idx])
       seen <- seen + batch.size
       for (o in outs) mx.nd.free(o)
@@ -318,9 +319,9 @@ mx.model.predict <- function(model, X, batch.size = 32) {
                    status = integer(1)))
     mx.executor.forward(model$executor, is.train = FALSE)
     outs <- mx.executor.outputs(model$executor)
-    prob <- as.array.mxtpu.ndarray(outs[[1]])
+    prob <- as.array.mxtpu.ndarray(outs[[1]])  # batch x classes
     for (o in outs) mx.nd.free(o)
-    preds <- cbind(preds, prob)
+    preds <- rbind(preds, prob)
   }
-  preds
+  preds  # N x classes
 }

@@ -52,8 +52,8 @@ model <- mx.model.FeedForward.create(net, X, y, batch.size = 32,
 stopifnot(model$train_acc > 0.9)
 
 # --- predict + symbol JSON round-trip ---------------------------------------
-prob <- mx.model.predict(model, X, batch.size = 32)
-pred <- max.col(t(prob)) - 1
+prob <- mx.model.predict(model, X, batch.size = 32)  # N x classes
+pred <- max.col(prob) - 1
 cat(sprintf("final train accuracy: %.4f\n", mean(pred == y)))
 
 js <- mx.symbol.tojson(net)

@@ -196,11 +196,13 @@ void mxr_sym_fromjson(char** js, int* id_out, int* status) {
 }
 
 // infer shapes given data shape; writes ndim+dims per argument
-// (flattened, 8 slots per arg) and the same for aux states
+// (flattened, 8 slots per arg) and the same for aux states. `cap` is the
+// number of per-argument slots the R caller allocated; exceeding it is an
+// error, never an out-of-bounds write.
 void mxr_sym_infer_shapes(int* id, char** data_name, int* data_shape,
-                          int* data_ndim, int* n_args_out, int* arg_ndims,
-                          int* arg_shapes, int* n_aux_out, int* aux_ndims,
-                          int* aux_shapes, int* status) {
+                          int* data_ndim, int* cap, int* n_args_out,
+                          int* arg_ndims, int* arg_shapes, int* n_aux_out,
+                          int* aux_ndims, int* aux_shapes, int* status) {
   const char* keys[1] = {data_name[0]};
   mx_uint ind[2] = {0, (mx_uint)*data_ndim};
   std::vector<mx_uint> shp(*data_ndim);
@@ -213,6 +215,13 @@ void mxr_sym_infer_shapes(int* id, char** data_name, int* data_shape,
       get_handle(*id), 1, keys, ind, shp.data(), &in_n, &in_nd, &in_d,
       &out_n, &out_nd, &out_d, &aux_n, &aux_nd, &aux_d, &complete));
   if (*status != 0) return;
+  if ((int)in_n > *cap || (int)aux_n > *cap) {
+    g_last_error = "infer_shapes: symbol has more arguments than the "
+                   "caller-provided capacity; raise max_args in "
+                   "mx.symbol.infer.shapes";
+    *status = -1;
+    return;
+  }
   *n_args_out = (int)in_n;
   for (mx_uint i = 0; i < in_n; ++i) {
     arg_ndims[i] = (int)in_nd[i];
@@ -260,8 +269,13 @@ void mxr_exec_outputs(int* id, int* ids_out, int* n_out, int* status) {
   NDArrayHandle* outs;
   *status = record(MXExecutorOutputs(get_handle(*id), &n, &outs));
   if (*status != 0) return;
+  if (n > 64) {  // R caller allocates 64 id slots
+    g_last_error = "executor has more than 64 outputs";
+    *status = -1;
+    return;
+  }
   *n_out = (int)n;
-  for (mx_uint i = 0; i < n && i < 64; ++i) ids_out[i] = put_handle(outs[i]);
+  for (mx_uint i = 0; i < n; ++i) ids_out[i] = put_handle(outs[i]);
 }
 
 }  // extern "C"

@@ -228,7 +228,6 @@ class _DistKVStore(KVStore):
         if self._nproc == 1:
             return arr
         import jax.numpy as jnp
-        from jax.experimental import multihost_utils
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self._proc_mesh()
@@ -239,8 +238,14 @@ class _DistKVStore(KVStore):
             fn = jax.jit(lambda g: jnp.sum(g, axis=0),
                          out_shardings=NamedSharding(mesh, P()))
             self._allreduce_cache[key] = fn
-        g = multihost_utils.host_local_array_to_global_array(
-            np.asarray(x)[None], mesh, P("p"))
+        # assemble the global array straight from the device-resident local
+        # value (device_put is device-to-device here) — no host numpy
+        # staging on the push path (round-2 review item)
+        mine = next(d for d in mesh.devices.flat
+                    if d.process_index == jax.process_index())
+        shard = jax.device_put(jnp.expand_dims(x, 0), mine)
+        g = jax.make_array_from_single_device_arrays(
+            (self._nproc,) + x.shape, NamedSharding(mesh, P("p")), [shard])
         summed = fn(g)
         return NDArray(summed.addressable_data(0))
 

@@ -196,3 +196,65 @@ def test_image_record_iter_uint8_output(tmp_path):
         np.testing.assert_allclose(bf.data[0].asnumpy(), u.astype(np.float32))
     with pytest.raises(mx.base.MXNetError):
         mio.ImageRecordIter(output_dtype="uint8", scale=0.5, **kw)
+
+
+def test_native_resize_matches_float_bilinear(tmp_path):
+    """The fixed-point (16.16) bilinear resize must match a float
+    reference within 1 LSB, and the identity-resize fast path (source
+    already at the target short side) must be pixel-exact."""
+    path = str(tmp_path / "resize.rec")
+    w = rio.MXRecordIO(path, "w")
+    yy, xx = np.meshgrid(np.arange(48), np.arange(64), indexing="ij")
+    img = np.stack([(yy * 255 / 48), (xx * 255 / 64),
+                    ((yy + xx) * 255 / 112)], axis=-1).astype(np.uint8)
+    w.write(rio.pack_img(rio.IRHeader(0, 0.0, 0, 0), img, quality=100,
+                         img_fmt=".jpg"))
+    # second record: already at target geometry (identity-resize path)
+    img2 = img[:32, :32]
+    w.write(rio.pack_img(rio.IRHeader(0, 1.0, 1, 0), img2, quality=100,
+                         img_fmt=".jpg"))
+    w.close()
+    offs = native_mod.scan_offsets(path)
+
+    # resize short side 48x64 -> 32(x43), center-crop 32
+    pipe = native_mod.NativePipeline(path, offs, batch=2,
+                                     data_shape=(3, 32, 32), resize=32)
+    data, labels, pad = pipe.next()
+
+    # decode the same source through the Python-side reader (shared
+    # libjpeg -> identical pixels), then float bilinear with the same
+    # corner-aligned mapping as the reference result
+    r = rio.MXRecordIO(path, "r")
+    _, src = rio.unpack_img(r.read())
+    _, src2 = rio.unpack_img(r.read())
+    r.close()
+
+    def float_bilinear(s, dh, dw):
+        sh, sw = s.shape[:2]
+        ry = (sh - 1) / (dh - 1) if dh > 1 else 0.0
+        rx = (sw - 1) / (dw - 1) if dw > 1 else 0.0
+        out = np.empty((dh, dw, 3), np.float64)
+        for y in range(dh):
+            fy = y * ry
+            y0, wy = int(fy), fy - int(fy)
+            y1 = min(y0 + 1, sh - 1)
+            for x in range(dw):
+                fx = x * rx
+                x0, wx = int(fx), fx - int(fx)
+                x1 = min(x0 + 1, sw - 1)
+                out[y, x] = (s[y0, x0] * (1 - wy) * (1 - wx)
+                             + s[y0, x1] * (1 - wy) * wx
+                             + s[y1, x0] * wy * (1 - wx)
+                             + s[y1, x1] * wy * wx)
+        return np.round(out)
+
+    # record 0: short side 48 -> 32, so full resize to (32, 43); crop 32
+    ref = float_bilinear(src.astype(np.float64), 32, 43)
+    left = (43 - 32) // 2
+    ref_crop = ref[:, left:left + 32]
+    got = data[0].transpose(1, 2, 0)
+    assert np.max(np.abs(got - ref_crop)) <= 1.0 + 1e-9  # 1 LSB rounding
+
+    # record 1: already 32x32 -> identity path, must be exactly the decode
+    got2 = data[1].transpose(1, 2, 0)
+    np.testing.assert_array_equal(got2, src2.astype(np.float32))

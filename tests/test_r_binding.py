@@ -307,8 +307,8 @@ def test_r_train_shim_trains_mlp(train_shim):
     aux_shapes = (ctypes.c_int * (max_args * 8))()
     st = _p_int(1)
     lib.mxr_sym_infer_shapes(_p_int(sm), _p_str("data"), _p_int(16, 4),
-                             _p_int(2), n_args, arg_ndims, arg_shapes,
-                             n_aux, aux_ndims, aux_shapes, st)
+                             _p_int(2), _p_int(max_args), n_args, arg_ndims,
+                             arg_shapes, n_aux, aux_ndims, aux_shapes, st)
     _st(lib, None, st)
     assert n_args[0] == 6
     shapes = []
