@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -298,6 +299,18 @@ def main():
         return
 
     import jax
+
+    # Persistent compilation cache: the tunnel's compile service degrades
+    # unpredictably (round 2's capture died on it; this session saw ResNet
+    # compiles go from ~40 s to >25 min). A warm on-disk cache makes the
+    # bench independent of compile-service health.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("MXTPU_JAX_CACHE_DIR",
+                                         "/tmp/mxtpu_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception as e:  # pragma: no cover - older jax
+        print(f"compilation cache unavailable: {e}", file=sys.stderr)
 
     dev = with_retries(lambda: jax.devices()[0], what="device init")
     print(f"bench device: {dev}", file=sys.stderr)

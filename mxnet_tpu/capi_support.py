@@ -76,6 +76,13 @@ _FUNCTIONS: dict = {
 class CApi:
     """Instance methods = the C API, one per MX* entry point."""
 
+    def __init__(self):
+        # host mirrors handed out by MXNDArrayGetData: identity -> (owner,
+        # buffer). Holding the owner pins both for the process lifetime,
+        # matching the reference's pointer-into-live-tensor contract (the
+        # C layer frees handles, not data pointers).
+        self._host_views: dict = {}
+
     # -- ndarray ------------------------------------------------------------
     def ndarray_create_none(self):
         return NDArray(np.zeros((1,), np.float32))
@@ -136,11 +143,26 @@ class CApi:
         return tuple(int(s) for s in array.shape)
 
     def ndarray_data_ptr(self, array):
-        # keep the host mirror alive on the wrapper, reference returns a
-        # pointer into the CPU tensor (c_api.cc MXNDArrayGetData)
+        # The reference returns a pointer into the CPU tensor
+        # (c_api.cc MXNDArrayGetData); here a host mirror is materialized
+        # and kept alive as long as the NDArray handle is (NDArray is
+        # slotted, so the mirror lives in a side table keyed by identity).
+        # Repeat calls REFRESH the existing buffer in place so previously
+        # returned pointers stay valid AND current; MXNDArrayFree evicts
+        # via ndarray_drop_host_view.
         host = np.ascontiguousarray(array.asnumpy().astype(np.float32))
-        array._capi_host_view = host
+        prev = self._host_views.get(id(array))
+        if prev is not None and prev[1].shape == host.shape:
+            np.copyto(prev[1], host)
+            return prev[1].ctypes.data
+        self._host_views[id(array)] = (array, host)
         return host.ctypes.data
+
+    def ndarray_drop_host_view(self, obj):
+        """Called by MXNDArrayFree (for every handle kind — non-NDArray ids
+        simply miss) so the GetData mirror and its owner ref die with the
+        handle, reference-pointer-lifetime semantics."""
+        self._host_views.pop(id(obj), None)
 
     def ndarray_context(self, array):
         c = array.context

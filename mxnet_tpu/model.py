@@ -31,6 +31,7 @@ most — reference call stack in SURVEY.md §3.1):
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 
@@ -121,6 +122,17 @@ def _host_local(x):
     return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
 
 
+def _to_dev(x, dev):
+    """Move an array to `dev` unless it already lives there (committed
+    host arrays from data iterators must not pin jit to the cpu backend)."""
+    try:
+        if isinstance(x, jax.Array) and x.devices() == {dev}:
+            return x
+    except Exception:  # pragma: no cover - non-Array leaves
+        pass
+    return jax.device_put(x, dev)
+
+
 def _place(value, sharding):
     """Place host data onto a (possibly multi-process) mesh sharding.
 
@@ -187,7 +199,14 @@ class FeedForward(BASE_ESTIMATOR):
 
     # -- parameter init -------------------------------------------------------
     def _init_params(self, input_shapes, overwrite=False):
-        """Infer shapes and run the initializer (reference: model.py:556-569)."""
+        """Infer shapes and run the initializer (reference: model.py:556-569).
+
+        Runs entirely on the HOST cpu backend (jax.default_device): the
+        initializer dispatches many small ops per parameter, and when the
+        default device is a remote/tunneled TPU each would pay a network
+        round-trip — ~270 arrays of a ResNet cost minutes before the first
+        batch. Parameters upload once, in bulk, when the train state is
+        built."""
         arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
         arg_names = self.symbol.list_arguments()
         input_names = set(input_shapes.keys())
@@ -196,18 +215,25 @@ class FeedForward(BASE_ESTIMATOR):
         shape_of = dict(zip(arg_names, arg_shapes))
         arg_params = dict(self.arg_params or {})
         aux_params = dict(self.aux_params or {})
-        for name in param_names:
-            if name in arg_params and not overwrite:
-                continue
-            arr = nd.zeros(shape_of[name], cpu())
-            self.initializer(name, arr)
-            arg_params[name] = arr
-        for name, shape in zip(aux_names, aux_shapes):
-            if name in aux_params and not overwrite:
-                continue
-            arr = nd.zeros(shape, cpu())
-            self.initializer(name, arr)
-            aux_params[name] = arr
+        try:
+            host = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:  # no cpu backend registered
+            host = None
+        scope = jax.default_device(host) if host is not None \
+            else contextlib.nullcontext()
+        with scope:
+            for name in param_names:
+                if name in arg_params and not overwrite:
+                    continue
+                arr = nd.zeros(shape_of[name], cpu())
+                self.initializer(name, arr)
+                arg_params[name] = arr
+            for name, shape in zip(aux_names, aux_shapes):
+                if name in aux_params and not overwrite:
+                    continue
+                arr = nd.zeros(shape, cpu())
+                self.initializer(name, arr)
+                aux_params[name] = arr
         self.arg_params, self.aux_params = arg_params, aux_params
         return param_names, aux_names
 
@@ -299,7 +325,22 @@ class FeedForward(BASE_ESTIMATOR):
             return new_params, new_opt_state, new_aux, outs, mstate
 
         if mesh is None:
-            return jax.jit(step, donate_argnums=(0, 1, 2, 6))
+            # Single-device path: pin everything to the ctx device. Data
+            # iterators hand over host-committed arrays, and jit follows
+            # committed inputs — without this, one cpu-committed batch
+            # silently drags the WHOLE train step onto the host backend
+            # (observed through the remote-TPU tunnel: 95 s/batch on the
+            # 1-core host instead of 25 ms on the chip).
+            dev = self.ctx[0].jax_device
+            jitted = jax.jit(step, donate_argnums=(0, 1, 2, 6))
+
+            def run(params, opt_state, aux, batch, rng, lr, mstate):
+                batch = {k: _to_dev(v, dev) for k, v in batch.items()}
+                params = {k: _to_dev(v, dev) for k, v in params.items()}
+                aux = {k: _to_dev(v, dev) for k, v in aux.items()}
+                return jitted(params, opt_state, aux, batch, rng, lr, mstate)
+
+            return run
         repl = NamedSharding(mesh, P())
         batch_sh = NamedSharding(mesh, P("dp"))
         jitted = jax.jit(step, donate_argnums=(0, 1, 2, 6))
@@ -555,6 +596,16 @@ class FeedForward(BASE_ESTIMATOR):
                     cb(epoch, self.symbol, self.arg_params, self.aux_params)
         return self
 
+    def _batch_to_ctx(self, arrays):
+        """Place batch arrays on the ctx device. Iterators hand over
+        host-committed arrays; jit follows committed inputs, so forwarding
+        them unmoved would run the compiled program on the host backend
+        (see _build_train_step's single-device note)."""
+        dev = self.ctx[0].jax_device
+        if isinstance(arrays, dict):
+            return {k: _to_dev(v, dev) for k, v in arrays.items()}
+        return [_to_dev(v, dev) for v in arrays]
+
     def _fill_missing_args(self, params, batch_arrays, symbol=None):
         """Zero-fill label args absent at inference time (forward of loss
         heads ignores labels; reference predict binds them as zeros too)."""
@@ -624,15 +675,16 @@ class FeedForward(BASE_ESTIMATOR):
             bkey = getattr(batch, "bucket_key", None)
             names = getattr(batch, "data_names", data_names)
             batch_arrays = {name: arr.data for name, arr in zip(names, batch.data)}
-            batch_arrays = self._fill_missing_args(
-                params, batch_arrays, symbol=self._symbol_for_bucket(bkey))
+            batch_arrays = self._batch_to_ctx(self._fill_missing_args(
+                params, batch_arrays, symbol=self._symbol_for_bucket(bkey)))
             pad = batch.pad
             if use_device_metric and pad == 0:
                 # fused forward+metric, no per-batch host pull; padded tail
                 # batches (at most one per epoch) take the host path below
                 estep = self._get_eval_metric_step(bkey, eval_metric)
                 maccum.state = estep(params, aux, batch_arrays,
-                                     [l.data for l in batch.label],
+                                     self._batch_to_ctx(
+                                         [l.data for l in batch.label]),
                                      maccum.state)
                 maccum.after_batch(batch.label)
                 continue
@@ -663,8 +715,8 @@ class FeedForward(BASE_ESTIMATOR):
             pred = self._get_pred_step(bkey)
             names = getattr(batch, "data_names", data_names)
             batch_arrays = {name: arr.data for name, arr in zip(names, batch.data)}
-            batch_arrays = self._fill_missing_args(
-                params, batch_arrays, symbol=self._symbol_for_bucket(bkey))
+            batch_arrays = self._batch_to_ctx(self._fill_missing_args(
+                params, batch_arrays, symbol=self._symbol_for_bucket(bkey)))
             outs = pred(params, aux, batch_arrays)
             pad = batch.pad
             outs = [np.asarray(o[: o.shape[0] - pad] if pad else o) for o in outs]

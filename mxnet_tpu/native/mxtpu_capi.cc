@@ -362,6 +362,15 @@ int MXNDArrayFree(NDArrayHandle handle) {
   if (handle == nullptr) return 0;
   API_ENTER();
   Box* b = static_cast<Box*>(handle);
+  if (b->obj != nullptr) {
+    // release any host mirror MXNDArrayGetData handed out for this handle
+    PyObject* r = call_api("ndarray_drop_host_view",
+                           Py_BuildValue("(O)", b->obj));
+    if (r == nullptr)
+      PyErr_Clear();  // freeing must not fail
+    else
+      Py_DECREF(r);
+  }
   Py_XDECREF(b->obj);
   Py_XDECREF(b->aux);
   delete b;

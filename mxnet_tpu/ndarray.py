@@ -346,10 +346,14 @@ def empty(shape, ctx=None, dtype=real_t) -> NDArray:
 
 
 def zeros(shape, ctx=None, dtype=real_t) -> NDArray:
+    # host-side np.zeros + one device_put: jnp.zeros would allocate on the
+    # DEFAULT backend first (a remote round-trip per array when the default
+    # device is a tunneled TPU and ctx is cpu — this is the hot path of
+    # parameter init, ~270 arrays for a ResNet)
     if isinstance(shape, int):
         shape = (shape,)
     return NDArray(
-        jax.device_put(jnp.zeros(shape, dtype=dtype), _resolve_ctx(ctx).jax_device)
+        jax.device_put(np.zeros(shape, dtype=dtype), _resolve_ctx(ctx).jax_device)
     )
 
 
@@ -357,7 +361,7 @@ def ones(shape, ctx=None, dtype=real_t) -> NDArray:
     if isinstance(shape, int):
         shape = (shape,)
     return NDArray(
-        jax.device_put(jnp.ones(shape, dtype=dtype), _resolve_ctx(ctx).jax_device)
+        jax.device_put(np.ones(shape, dtype=dtype), _resolve_ctx(ctx).jax_device)
     )
 
 

@@ -409,3 +409,50 @@ def test_random_seed_and_error_path(lib):
     rc = lib.MXSymbolCreateFromJSON(b"{not json", ctypes.byref(h))
     assert rc == -1
     assert len(lib.MXGetLastError()) > 0
+
+
+def test_ndarray_raw_bytes_roundtrip(lib):
+    a = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+    h = make_ndarray(lib, a)
+    size = ctypes.c_size_t()
+    buf = ctypes.POINTER(ctypes.c_char)()
+    check(lib, lib.MXNDArraySaveRawBytes(h, ctypes.byref(size),
+                                         ctypes.byref(buf)))
+    assert size.value > a.nbytes
+    raw = ctypes.string_at(buf, size.value)
+    h2 = NDHandle()
+    check(lib, lib.MXNDArrayLoadFromRawBytes(raw, len(raw),
+                                             ctypes.byref(h2)))
+    assert np.array_equal(read_ndarray(lib, h2), a)
+
+
+def test_symbol_internals_and_output_slice(lib):
+    sm = _make_mlp_symbol(lib)
+    internals = ctypes.c_void_p()
+    check(lib, lib.MXSymbolGetInternals(sm, ctypes.byref(internals)))
+    n = mx_uint()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    check(lib, lib.MXSymbolListOutputs(internals, ctypes.byref(n),
+                                       ctypes.byref(names)))
+    outs = [names[i].decode() for i in range(n.value)]
+    assert "fc1_output" in outs
+    idx = outs.index("fc1_output")
+    head = ctypes.c_void_p()
+    check(lib, lib.MXSymbolGetOutput(internals, idx, ctypes.byref(head)))
+    n2 = mx_uint()
+    check(lib, lib.MXSymbolListOutputs(head, ctypes.byref(n2),
+                                       ctypes.byref(names)))
+    assert n2.value == 1 and names[0] == b"fc1_output"
+
+
+def test_wait_and_shutdown_and_getdata(lib):
+    a = make_ndarray(lib, np.arange(6, dtype=np.float32).reshape(2, 3))
+    check(lib, lib.MXNDArrayWaitToRead(a))
+    check(lib, lib.MXNDArrayWaitToWrite(a))
+    check(lib, lib.MXNDArrayWaitAll())
+    p = ctypes.POINTER(ctypes.c_float)()
+    check(lib, lib.MXNDArrayGetData(a, ctypes.byref(p)))
+    assert [p[i] for i in range(6)] == [0, 1, 2, 3, 4, 5]
+    check(lib, lib.MXNotifyShutdown())  # no-op, must not invalidate state
+    b = make_ndarray(lib, np.ones((2, 2)))
+    assert np.allclose(read_ndarray(lib, b), 1.0)
