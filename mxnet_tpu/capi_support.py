@@ -199,8 +199,10 @@ class CApi:
                                   mutate_vars[0].shape)._data)
             return
         if name == "_onehot_encode":
-            nd.onehot_encode(use_vars[0], mutate_vars[1] if len(mutate_vars) > 1
-                             else mutate_vars[0])
+            # arity (2, 0, 1): use=(indices, out), mutate=(out,) — the
+            # second use var IS the output buffer (reference
+            # ndarray_function.h OneHotEncode semantics)
+            nd.onehot_encode(use_vars[0], mutate_vars[0])
             return
         fn = getattr(nd, name)
         out = mutate_vars[0] if mutate_vars else None
