@@ -291,8 +291,36 @@ def main():
     ap.add_argument("--epochs", type=int, default=3)
     args = ap.parse_args()
 
+    # Watchdog first: EVERY mode that can touch the tunnel must fail fast
+    # when it wedges (see the note below) instead of eating the driver's
+    # timeout budget. Installed before mode dispatch.
+    import faulthandler
+    import threading
+
+    deadline = int(os.environ.get("MXTPU_BENCH_DEADLINE_SEC", "1500"))
+
+    def _watchdog():
+        print(f"bench watchdog: no result within {deadline}s — the TPU "
+              "tunnel is likely wedged (see BENCH_NOTES_r03.md section 6); "
+              "dumping stacks and exiting", file=sys.stderr)
+        faulthandler.dump_traceback(file=sys.stderr)
+        os._exit(3)
+
+    timer = threading.Timer(deadline, _watchdog)
+    timer.daemon = True
+    timer.start()
+
     if args.mode == "pipeline":
+        # host-only benchmark: force the cpu platform so NDArray creation
+        # never initializes the (possibly wedged) remote backend
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
         run_pipeline_bench(args)
+        timer.cancel()
         return
     if args.mode == "io":
         run_io_bench(args)
@@ -300,6 +328,7 @@ def main():
 
     import jax
 
+    # (watchdog active from mode dispatch above)
     # Persistent compilation cache: the tunnel's compile service degrades
     # unpredictably (round 2's capture died on it; this session saw ResNet
     # compiles go from ~40 s to >25 min). A warm on-disk cache makes the
@@ -405,6 +434,7 @@ def main():
     except Exception:
         peak = None
 
+    timer.cancel()
     baseline = 97.0  # Inception-BN img/s, 1x GTX 980 cuDNN v3 (BASELINE.md)
     print(json.dumps({
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip",

@@ -6,7 +6,6 @@ failures give readable diffs instead of -1s."""
 import numpy as np
 import pytest
 
-import mxnet_tpu as mx
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.capi_support import CApi
 from mxnet_tpu.ndarray import NDArray
