@@ -76,8 +76,16 @@ void mxr_nd_shape(int* id, int* ndim_out, int* shape_out, int* status) {
   const mx_uint* dims;
   *status = record(MXNDArrayGetShape(get_handle(*id), &nd, &dims));
   if (*status != 0) return;
+  if (nd > 8) {
+    // the R caller indexes seq_len(ndim) into integer(8); reporting the
+    // full ndim with a truncated copy would hand it NA dims — fail loudly
+    // instead (same capacity contract as mxr_sym_infer_shapes)
+    g_last_error = "mxr_nd_shape: array has more than 8 dimensions";
+    *status = -1;
+    return;
+  }
   *ndim_out = (int)nd;
-  for (mx_uint i = 0; i < nd && i < 8; ++i) shape_out[i] = (int)dims[i];
+  for (mx_uint i = 0; i < nd; ++i) shape_out[i] = (int)dims[i];
 }
 
 void mxr_nd_set(int* id, double* data, int* n, int* status) {

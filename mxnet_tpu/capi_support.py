@@ -159,9 +159,10 @@ class CApi:
         return host.ctypes.data
 
     def ndarray_drop_host_view(self, obj):
-        """Called by MXNDArrayFree (for every handle kind — non-NDArray ids
-        simply miss) so the GetData mirror and its owner ref die with the
-        handle, reference-pointer-lifetime semantics."""
+        """Called by MXNDArrayFree when the LAST handle boxing ``obj`` dies
+        (the C side keeps a live-box count, so pointers obtained through one
+        handle survive the free of another handle on the same array; see
+        g_box_counts in mxtpu_capi.cc). Non-NDArray ids simply miss."""
         self._host_views.pop(id(obj), None)
 
     def ndarray_context(self, array):

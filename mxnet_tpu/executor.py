@@ -334,9 +334,18 @@ class Executor:
             # so activation memory frees before the caller's optimizer
             # update; a second backward() without a new forward falls
             # through to the fused-recompute path below
-            grads = self._bwd_apply_fn(leaves, cots)
-            self._write_grads(diff_names, grads)
-            return
+            try:
+                grads = self._bwd_apply_fn(leaves, cots)
+            except Exception:  # pragma: no cover - backend-dependent
+                # e.g. residual leaves whose treedef no longer matches, or
+                # non-array leaves a backend rejects: disable residual
+                # capture and recompute via the fused path (self._last
+                # still holds the forward inputs)
+                self._res_ok = False
+                self._bwd_apply_fn = None
+            else:
+                self._write_grads(diff_names, grads)
+                return
 
         if self._bwd_fn is None:
             fwd = _build_graph_fn(self._symbol, True)

@@ -551,7 +551,12 @@ class ImageRecordIter(DataIter):
             seen_marker = seen_marker or marker_live
             if seen_marker and not marker_live and not os.path.exists(marker):
                 # worker 0 finished or died; give the cache one more poll
+                # before any grace-timeout branch below can fire — the cache
+                # file may become visible a beat after the marker unlink
+                # (os.replace vs unlink ordering is not atomic across NFS)
                 seen_marker = False
+                _time.sleep(poll)
+                continue
             waited = _time.monotonic() - start
             if seen_marker and not marker_live and waited > grace:
                 # marker exists but has gone stale: worker 0 was killed

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import time
 
 import jax
@@ -143,6 +144,83 @@ def _place(value, sharding):
         return jax.make_array_from_process_local_data(sharding,
                                                       np.asarray(value))
     return jax.device_put(value, sharding)
+
+
+class _AsyncDeviceFeed:
+    """Double-buffered feed/compute overlap for the train loop.
+
+    A background thread draws batches from the (already host-prefetching)
+    iterator and immediately starts their async host->device transfer, so
+    by the time the train loop needs batch N+1, both its host assembly and
+    its wire/PCIe transfer have been hiding under the device's step N.
+    Without this, the transfer only starts after step N is *dispatched*,
+    and an io-fed epoch costs feed + compute instead of max(feed, compute)
+    (reference overlapped IO the same way by construction:
+    src/io/iter_prefetcher.h:34-126 — a ThreadedIter in front of the
+    consumer; here the device transfer itself is part of the hidden work).
+
+    ``depth`` bounds in-flight batches (2 = classic double buffering) so a
+    fast iterator cannot queue an epoch of device buffers. Iterator
+    exceptions surface in the consuming thread. Disable with
+    MXTPU_FEED_PREFETCH=0 (the fit loop then feeds synchronously).
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, data_iter, extract, place, depth=2):
+        import queue
+        import threading
+
+        self._q = queue.Queue(maxsize=max(1, int(depth)))
+        self._err = None
+        self._closed = False
+
+        def worker():
+            try:
+                for batch in data_iter:
+                    # place() dispatches the async device_put; the consumer
+                    # gets arrays whose transfer is already in flight
+                    item = (batch, place(extract(batch)))
+                    while not self._closed:
+                        try:
+                            self._q.put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._closed:
+                        return
+            except BaseException as e:  # noqa: BLE001 - re-raised on main
+                self._err = e
+            finally:
+                try:
+                    self._q.put(self._SENTINEL, timeout=1.0)
+                except queue.Full:  # pragma: no cover - closed mid-drain
+                    pass
+
+        self._thread = threading.Thread(
+            target=worker, daemon=True, name="mxtpu-device-feed")
+        self._thread.start()
+
+    def close(self):
+        """Stop the worker and release the iterator (so a caller that hits
+        an exception mid-epoch can reset() the iterator without racing the
+        still-feeding thread)."""
+        self._closed = True
+        while not self._q.empty():
+            try:
+                self._q.get_nowait()
+            except Exception:  # pragma: no cover - drained concurrently
+                break
+        self._thread.join(timeout=5.0)
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._SENTINEL:
+                if self._err is not None:
+                    raise self._err
+                return
+            yield item
 
 
 def _create_kvstore(kvstore, num_device, arg_params):
@@ -501,6 +579,32 @@ class FeedForward(BASE_ESTIMATOR):
         # all entries share the same live param/opt-state pytrees.
         train_steps = {}
 
+        # Feed/compute overlap: batch extraction + async device transfer run
+        # on a background thread (double-buffered), so an io-fed epoch costs
+        # max(feed, compute) per step, not the sum (see _AsyncDeviceFeed).
+        def _extract_batch(batch):
+            arrays = {}
+            for name, arr in zip(getattr(batch, "data_names", data_names),
+                                 batch.data):
+                arrays[name] = arr.data
+            for name, arr in zip(getattr(batch, "label_names", label_names),
+                                 batch.label):
+                arrays[name] = arr.data
+            return arrays
+
+        if mesh is None:
+            _feed_dev = self.ctx[0].jax_device
+
+            def _place_batch(arrays):
+                return {k: _to_dev(v, _feed_dev) for k, v in arrays.items()}
+        else:
+            _feed_sh = NamedSharding(mesh, P("dp"))
+
+            def _place_batch(arrays):
+                return {k: _place(v, _feed_sh) for k, v in arrays.items()}
+
+        feed_depth = int(os.environ.get("MXTPU_FEED_PREFETCH", "2"))
+
         eval_metric = metric_mod.create(eval_metric)
         # Device-resident metric accumulation whenever the metric supports it
         # and nothing needs per-batch host values: the (sum, count) scalars
@@ -517,50 +621,58 @@ class FeedForward(BASE_ESTIMATOR):
             maccum = self._DeviceMetricAccum(eval_metric)
             nbatch = 0
             train_data.reset()
-            for batch in train_data:
-                bkey = getattr(batch, "bucket_key", None)
-                b_dnames = getattr(batch, "data_names", data_names)
-                b_lnames = getattr(batch, "label_names", label_names)
-                if bkey not in train_steps:
-                    train_steps[bkey] = self._build_train_step(
-                        b_dnames, b_lnames, optimizer, mesh,
-                        symbol=self._symbol_for_bucket(bkey),
-                        metric_update=metric_update,
-                        apply_update=not async_kv)
-                train_step = train_steps[bkey]
-                batch_arrays = {}
-                for name, arr in zip(b_dnames, batch.data):
-                    batch_arrays[name] = arr.data
-                for name, arr in zip(b_lnames, batch.label):
-                    batch_arrays[name] = arr.data
-                rng = random_mod.next_key()
-                lr = optimizer._get_lr()
-                optimizer.num_update = num_update
-                params, opt_state, aux, outs, maccum.state = train_step(
-                    params, opt_state, aux, batch_arrays, rng, lr, maccum.state
-                )
-                if async_kv:
-                    # params slot carries grads (apply_update=False): ONE
-                    # round trip applies them on the host (updated on
-                    # arrival) and returns the fresh weights —
-                    # unbounded-staleness async, like the reference's
-                    # dist_async worker loop
-                    pulled = kv.push_pull({name: _host_local(params[name])
-                                           for name in param_names})
-                    params = {k: jnp.asarray(pulled[k]) for k in param_names}
-                num_update += 1
-                if use_device_metric:
-                    maccum.after_batch(batch.label)
-                else:
-                    eval_metric.update(
-                        batch.label,
-                        [NDArray(_host_local(o))
-                         for o in outs[: len(batch.label)]])
-                nbatch += 1
-                if batch_end_callback is not None:
-                    p = BatchEndParam(epoch=epoch, nbatch=nbatch, eval_metric=eval_metric)
-                    for cb in _as_list(batch_end_callback):
-                        cb(p)
+            if feed_depth > 0:
+                feed = _AsyncDeviceFeed(train_data, _extract_batch,
+                                        _place_batch, depth=feed_depth)
+            else:  # MXTPU_FEED_PREFETCH=0: synchronous feed (debugging)
+                feed = ((b, _place_batch(_extract_batch(b)))
+                        for b in train_data)
+            try:
+                for batch, batch_arrays in feed:
+                    bkey = getattr(batch, "bucket_key", None)
+                    b_dnames = getattr(batch, "data_names", data_names)
+                    b_lnames = getattr(batch, "label_names", label_names)
+                    if bkey not in train_steps:
+                        train_steps[bkey] = self._build_train_step(
+                            b_dnames, b_lnames, optimizer, mesh,
+                            symbol=self._symbol_for_bucket(bkey),
+                            metric_update=metric_update,
+                            apply_update=not async_kv)
+                    train_step = train_steps[bkey]
+                    rng = random_mod.next_key()
+                    lr = optimizer._get_lr()
+                    optimizer.num_update = num_update
+                    params, opt_state, aux, outs, maccum.state = train_step(
+                        params, opt_state, aux, batch_arrays, rng, lr,
+                        maccum.state
+                    )
+                    if async_kv:
+                        # params slot carries grads (apply_update=False): ONE
+                        # round trip applies them on the host (updated on
+                        # arrival) and returns the fresh weights —
+                        # unbounded-staleness async, like the reference's
+                        # dist_async worker loop
+                        pulled = kv.push_pull({name: _host_local(params[name])
+                                               for name in param_names})
+                        params = {k: jnp.asarray(pulled[k])
+                                  for k in param_names}
+                    num_update += 1
+                    if use_device_metric:
+                        maccum.after_batch(batch.label)
+                    else:
+                        eval_metric.update(
+                            batch.label,
+                            [NDArray(_host_local(o))
+                             for o in outs[: len(batch.label)]])
+                    nbatch += 1
+                    if batch_end_callback is not None:
+                        p = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                          eval_metric=eval_metric)
+                        for cb in _as_list(batch_end_callback):
+                            cb(p)
+            finally:
+                if feed_depth > 0:
+                    feed.close()
             if use_device_metric:
                 maccum.finish()
             name, value = eval_metric.get()

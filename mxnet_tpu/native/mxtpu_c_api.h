@@ -68,6 +68,11 @@ int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
                    mx_uint slice_end, NDArrayHandle *out);
 int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
                       const mx_uint **out_pdata);
+/* READ-ONLY in this build: the pointer is a host mirror of the device
+ * array, refreshed on every call and kept alive until the last handle
+ * boxing the array is freed. Writes through it do NOT propagate to the
+ * device array (unlike the reference's pointer-into-live-CPU-tensor);
+ * use MXNDArraySyncCopyFromCPU to mutate. */
 int MXNDArrayGetData(NDArrayHandle handle, mx_float **out_pdata);
 int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
                         int *out_dev_id);

@@ -29,6 +29,7 @@
 #include <cstring>
 #include <deque>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -119,9 +120,27 @@ struct Gil {
   ~Gil() { PyGILState_Release(state); }
 };
 
+// Live-box count per boxed PyObject. Several handles can box the SAME
+// underlying object (MXExecutorOutputs and MXDataIterGetData each mint a
+// fresh box per call), while the Python side keys MXNDArrayGetData host
+// mirrors by that object — so the mirror must only be dropped when the
+// LAST box referencing the object dies, not on the first MXNDArrayFree.
+// GIL-protected: every box creation/destruction runs under API_ENTER's Gil.
+std::unordered_map<PyObject*, int> g_box_counts;
+
 Box* make_box(PyObject* obj /* stolen */) {
   Box* b = new Box{obj, nullptr};
+  if (obj != nullptr) ++g_box_counts[obj];
   return b;
+}
+
+// Decrement the live-box count for obj; true when this was the last box.
+bool last_box_released(PyObject* obj) {
+  auto it = g_box_counts.find(obj);
+  if (it == g_box_counts.end()) return true;
+  if (--it->second > 0) return false;
+  g_box_counts.erase(it);
+  return true;
 }
 
 PyObject* unbox(void* h) { return static_cast<Box*>(h)->obj; }
@@ -362,8 +381,9 @@ int MXNDArrayFree(NDArrayHandle handle) {
   if (handle == nullptr) return 0;
   API_ENTER();
   Box* b = static_cast<Box*>(handle);
-  if (b->obj != nullptr) {
-    // release any host mirror MXNDArrayGetData handed out for this handle
+  if (b->obj != nullptr && last_box_released(b->obj)) {
+    // release any host mirror MXNDArrayGetData handed out for this object —
+    // only now that no other live handle boxes it (g_box_counts)
     PyObject* r = call_api("ndarray_drop_host_view",
                            Py_BuildValue("(O)", b->obj));
     if (r == nullptr)
@@ -1152,6 +1172,7 @@ static int recordio_free(RecordIOHandle handle) {
   if (!r) return fail();
   Py_DECREF(r);
   Box* b = static_cast<Box*>(handle);
+  if (b->obj != nullptr) last_box_released(b->obj);  // keep counts balanced
   Py_XDECREF(b->obj);
   Py_XDECREF(b->aux);
   delete b;
