@@ -30,6 +30,8 @@ keeping the reference's memory-plan introspection story
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -341,6 +343,11 @@ class Executor:
                 # non-array leaves a backend rejects: disable residual
                 # capture and recompute via the fused path (self._last
                 # still holds the forward inputs)
+                logging.warning(
+                    "residual-path backward failed; falling back to fused "
+                    "forward+backward recompute for this executor "
+                    "(slower: forward re-runs every backward)",
+                    exc_info=True)
                 self._res_ok = False
                 self._bwd_apply_fn = None
             else:

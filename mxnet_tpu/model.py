@@ -192,10 +192,16 @@ class _AsyncDeviceFeed:
             except BaseException as e:  # noqa: BLE001 - re-raised on main
                 self._err = e
             finally:
-                try:
-                    self._q.put(self._SENTINEL, timeout=1.0)
-                except queue.Full:  # pragma: no cover - closed mid-drain
-                    pass
+                # the SENTINEL must not be droppable: with the queue full
+                # (feed faster than compute — the steady state) a single
+                # bounded put could time out and leave the consumer blocked
+                # in q.get() forever, so retry until delivered or closed
+                while not self._closed:
+                    try:
+                        self._q.put(self._SENTINEL, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
 
         self._thread = threading.Thread(
             target=worker, daemon=True, name="mxtpu-device-feed")

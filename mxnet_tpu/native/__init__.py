@@ -61,7 +61,8 @@ def get_lib():
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.c_float, ctypes.c_int,
             ctypes.c_uint32, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int]
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
         lib.mxtpu_pipeline_next.restype = ctypes.c_int
         lib.mxtpu_pipeline_next.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p,
@@ -96,10 +97,16 @@ class NativePipeline:
     def __init__(self, path, offsets, batch, data_shape, label_width=1,
                  rand_crop=False, rand_mirror=False, resize=-1, mean=None,
                  scale=1.0, shuffle=False, seed=0, num_threads=None,
-                 prefetch=4, round_batch=True, nhwc=False, out_u8=False):
-        if out_u8 and (mean is not None or scale != 1.0):
-            raise ValueError("uint8 output emits raw pixels: mean/scale "
-                             "must be left for the device side")
+                 prefetch=4, round_batch=True, nhwc=False, out_u8=False,
+                 min_random_scale=1.0, max_random_scale=1.0,
+                 min_img_size=0.0, max_img_size=0.0,
+                 max_random_contrast=0.0, max_random_illumination=0.0,
+                 mirror=False):
+        if out_u8 and (mean is not None or scale != 1.0
+                       or max_random_contrast or max_random_illumination):
+            raise ValueError("uint8 output emits raw pixels: mean/scale and "
+                             "contrast/illumination must be left for the "
+                             "device side")
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native library unavailable")
@@ -116,12 +123,18 @@ class NativePipeline:
         c, h, w = self.data_shape
         self.nhwc = bool(nhwc)
         self.out_u8 = bool(out_u8)
+        aug = (min_random_scale, max_random_scale, min_img_size,
+               max_img_size, max_random_contrast, max_random_illumination)
+        aug_ptr = None
+        if aug != (1.0, 1.0, 0.0, 0.0, 0.0, 0.0):
+            aug_arr = (ctypes.c_float * 6)(*[float(a) for a in aug])
+            aug_ptr = aug_arr
         self._handle = lib.mxtpu_pipeline_create(
             path.encode(), off, len(offsets), batch, c, h, w, label_width,
             int(rand_crop), int(rand_mirror), int(resize), mean_ptr,
             float(scale), int(shuffle), int(seed) & 0xFFFFFFFF,
             num_threads, prefetch, int(round_batch), int(self.nhwc),
-            int(self.out_u8))
+            int(self.out_u8), aug_ptr, int(mirror))
         if not self._handle:
             raise RuntimeError(f"failed to open native pipeline on {path!r}")
 

@@ -149,6 +149,15 @@ struct PipelineConfig {
   float mean[3];
   int has_mean;
   float scale;
+  // extended augmenters (reference image_augmenter.h / iter_normalize.h):
+  // random resize-scale in [min_rscale, max_rscale]; per-dimension size
+  // clamps (0 = off); photometric jitter out = (px - mean) * c + i with
+  // c ~ U[1-max_contrast, 1+max_contrast], i ~ U[-max_illum, max_illum];
+  // fixed mirror (vs the rand_mirror coin flip)
+  float min_rscale, max_rscale;
+  float min_img, max_img;
+  float max_contrast, max_illum;
+  int mirror;
   int shuffle;
   uint32_t seed;
   int num_threads, prefetch;
@@ -333,13 +342,31 @@ class ImagePipeline {
         return false;
       }
       const uint8_t* hwc = pixels.data();
-      // resize so the short side is resize_short (or to fit the crop)
+      // resize so the short side is resize_short (or to fit the crop),
+      // jittered by the random scale factor and clamped to the img-size
+      // bounds; the result stays crop-feasible (>= data_shape)
+      float rscale = 1.f;
+      if (cfg_.min_rscale != 1.f || cfg_.max_rscale != 1.f) {
+        float u = float((*rng)()) * (1.f / 4294967296.f);
+        rscale = cfg_.min_rscale + u * (cfg_.max_rscale - cfg_.min_rscale);
+      }
       int target_short = cfg_.resize_short;
-      if (h < H || w < W || target_short > 0) {
+      if (h < H || w < W || target_short > 0 || rscale != 1.f ||
+          cfg_.min_img > 0.f || cfg_.max_img > 0.f) {
         int short_side = std::min(h, w);
         float s = target_short > 0 ? float(target_short) / short_side : 1.f;
-        int nh = std::max(H, int(h * s + 0.5f));
-        int nw = std::max(W, int(w * s + 0.5f));
+        s *= rscale;
+        float fnh = h * s, fnw = w * s;
+        if (cfg_.min_img > 0.f) {
+          fnh = std::max(fnh, cfg_.min_img);
+          fnw = std::max(fnw, cfg_.min_img);
+        }
+        if (cfg_.max_img > 0.f) {
+          fnh = std::min(fnh, cfg_.max_img);
+          fnw = std::min(fnw, cfg_.max_img);
+        }
+        int nh = std::max(H, int(fnh + 0.5f));
+        int nw = std::max(W, int(fnw + 0.5f));
         if (nh != h || nw != w) {  // identity resize (already at target
           resized.resize(size_t(nh) * nw * 3);  // short side) is a no-op
           ResizeBilinear(pixels.data(), h, w, resized.data(), nh, nw);
@@ -357,6 +384,14 @@ class ImagePipeline {
         left = (w - W) / 2;
       }
       bool mirror = cfg_.rand_mirror && ((*rng)() & 1u);
+      if (cfg_.mirror) mirror = true;
+      float con = 1.f, ill = 0.f;
+      if (!cfg_.out_u8 && (cfg_.max_contrast > 0.f || cfg_.max_illum > 0.f)) {
+        float u1 = float((*rng)()) * (1.f / 4294967296.f);
+        float u2 = float((*rng)()) * (1.f / 4294967296.f);
+        con = 1.f + (u1 * 2.f - 1.f) * cfg_.max_contrast;
+        ill = (u2 * 2.f - 1.f) * cfg_.max_illum;
+      }
       const bool nhwc = cfg_.nhwc != 0;
       float* dst = cfg_.out_u8 ? nullptr
                                : out->data.data() + size_t(i) * C * H * W;
@@ -376,7 +411,7 @@ class ImagePipeline {
             } else {
               float v = float(px[c]);
               if (cfg_.has_mean) v -= cfg_.mean[c];
-              dst[at] = v * cfg_.scale;
+              dst[at] = (v * con + ill) * cfg_.scale;
             }
           }
         }
@@ -438,7 +473,10 @@ void* mxtpu_pipeline_create(const char* path, const int64_t* offsets,
                             int rand_crop, int rand_mirror, int resize_short,
                             const float* mean3, float scale, int shuffle,
                             uint32_t seed, int num_threads, int prefetch,
-                            int round_batch, int nhwc, int out_u8) {
+                            int round_batch, int nhwc, int out_u8,
+                            const float* aug6, int mirror) {
+  // aug6 (nullable): {min_random_scale, max_random_scale, min_img_size,
+  // max_img_size, max_random_contrast, max_random_illumination}
   PipelineConfig cfg;
   cfg.batch = batch;
   cfg.channels = channels;
@@ -458,6 +496,13 @@ void* mxtpu_pipeline_create(const char* path, const int64_t* offsets,
   cfg.round_batch = round_batch;
   cfg.nhwc = nhwc;
   cfg.out_u8 = out_u8;
+  cfg.min_rscale = aug6 ? aug6[0] : 1.f;
+  cfg.max_rscale = aug6 ? aug6[1] : 1.f;
+  cfg.min_img = aug6 ? aug6[2] : 0.f;
+  cfg.max_img = aug6 ? aug6[3] : 0.f;
+  cfg.max_contrast = aug6 ? aug6[4] : 0.f;
+  cfg.max_illum = aug6 ? aug6[5] : 0.f;
+  cfg.mirror = mirror;
   auto* p = new ImagePipeline(path, offsets, n_offsets, cfg);
   if (!p->ok()) {
     delete p;
