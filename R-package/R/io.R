@@ -1,0 +1,48 @@
+# Data iterators for the R binding (reference capability:
+# R-package/R/io.R — mx.io.NDArrayIter with reset/iter.next/value and the
+# batch/pad protocol FeedForward training consumes).
+#
+# R-native batching over R arrays: each value() call hands the CURRENT
+# batch to the caller as host data ready for mxr_nd_set. The heavy device
+# pipeline (RecordIO + native decode workers) stays on the Python/C++ side;
+# this iterator is the R-facing protocol adapter, like the reference's
+# (whose C-side NDArrayIter was likewise a batching shim over host arrays).
+
+# X: R array with the sample axis LAST (R convention, e.g. 28x28x1xN);
+# y: length-N labels. batch.size must be <= N; the last partial batch is
+# padded by wrapping around, with the pad count reported like the
+# reference's iterator pad() (io.R round-batch semantics).
+mx.io.NDArrayIter <- function(X, y, batch.size = 32, shuffle = FALSE) {
+  nd <- length(dim(X))
+  n <- dim(X)[nd]
+  feat_dims <- if (nd > 1) dim(X)[-nd] else integer(0)
+  Xflat <- array(X, dim = c(max(1, prod(feat_dims)), n))
+  env <- new.env()
+  env$order <- seq_len(n)
+  env$cursor <- 0L
+
+  reset <- function() {
+    if (shuffle) env$order <- sample(n)
+    env$cursor <- 0L
+    invisible(NULL)
+  }
+  iter.next <- function() {
+    if (env$cursor >= n) return(FALSE)
+    env$cursor <- env$cursor + batch.size
+    TRUE
+  }
+  value <- function() {
+    start <- env$cursor - batch.size + 1L
+    idx <- start:env$cursor
+    pad <- sum(idx > n)
+    idx[idx > n] <- idx[idx > n] - n  # wrap-around padding
+    sel <- env$order[idx]
+    # features-by-batch block: one column per sample, so as.double()
+    # (column-major flatten) IS the row-major (batch, features...) order
+    # mxr_nd_set expects — no transpose copies on the hot path
+    list(data = Xflat[, sel, drop = FALSE], label = y[sel], pad = pad,
+         data.shape = c(batch.size, rev(feat_dims)))
+  }
+  list(reset = reset, iter.next = iter.next, value = value,
+       batch.size = batch.size, num.samples = n)
+}

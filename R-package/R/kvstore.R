@@ -1,0 +1,52 @@
+# KVStore binding (reference capability: R-package/R/kvstore.R —
+# mx.kv.create and the init/push/pull surface over the C API's MXKVStore*).
+#
+# Keys are integers (the reference's R layer used integer keys too); values
+# are mxtpu.ndarray handles. push/pull on a 'local'/'device' store give the
+# aggregation semantics FeedForward training uses; on a 'dist_*' store the
+# same calls ride the process-collective backend.
+
+mx.kv.create <- function(type = "local") {
+  r <- .mxr.status(.C("mxr_kv_create", as.character(type), id = integer(1),
+                      status = integer(1)))
+  structure(r$id, class = "mxtpu.kvstore")
+}
+
+mx.kv.free <- function(kv) {
+  invisible(.C("mxr_kv_free", as.integer(kv), status = integer(1)))
+}
+
+.mx.kv.call <- function(entry, kv, keys, nds, priority = 0L) {
+  stopifnot(length(keys) == length(nds))
+  invisible(.mxr.status(.C(entry, as.integer(kv), as.integer(length(keys)),
+                           as.integer(keys), as.integer(unlist(nds)),
+                           as.integer(priority), status = integer(1))))
+}
+
+mx.kv.init <- function(kv, keys, nds) {
+  stopifnot(length(keys) == length(nds))
+  invisible(.mxr.status(.C("mxr_kv_init", as.integer(kv),
+                           as.integer(length(keys)), as.integer(keys),
+                           as.integer(unlist(nds)), status = integer(1))))
+}
+
+mx.kv.push <- function(kv, keys, nds, priority = 0L)
+  .mx.kv.call("mxr_kv_push", kv, keys, nds, priority)
+
+mx.kv.pull <- function(kv, keys, nds, priority = 0L)
+  .mx.kv.call("mxr_kv_pull", kv, keys, nds, priority)
+
+mx.kv.rank <- function(kv) {
+  .mxr.status(.C("mxr_kv_rank", as.integer(kv), rank = integer(1),
+                 status = integer(1)))$rank
+}
+
+mx.kv.num.workers <- function(kv) {
+  .mxr.status(.C("mxr_kv_size", as.integer(kv), size = integer(1),
+                 status = integer(1)))$size
+}
+
+mx.kv.barrier <- function(kv) {
+  invisible(.mxr.status(.C("mxr_kv_barrier", as.integer(kv),
+                           status = integer(1))))
+}

@@ -1,0 +1,183 @@
+# Model layer for the R binding (reference capability:
+# R-package/R/model.R — mx.model.FeedForward.create, mx.model.save,
+# mx.model.load over the C API).
+#
+# Checkpoint FORMAT PARITY: mx.model.save writes `prefix-symbol.json` +
+# `prefix-%04d.params` through the SAME container writer Python uses
+# (MXNDArraySave with arg:/aux: prefixed names), so checkpoints round-trip
+# between R and Python FeedForward.load/save in both directions
+# (mxnet_tpu/model.py:63-85 save_checkpoint/load_checkpoint).
+#
+# Training routes through the framework: batches come from
+# mx.io.NDArrayIter, gradients flow through the executor, and the update
+# runs via mx.opt.get.updater whose math executes inside the runtime
+# (registered NDArray functions — see optimizer.R). No in-R SGD.
+
+.mxr.nd.from.host <- function(shape_rowmajor, values) {
+  r <- .mxr.status(.C("mxr_nd_create", as.integer(shape_rowmajor),
+                      as.integer(length(shape_rowmajor)), id = integer(1),
+                      status = integer(1)))
+  .mxr.status(.C("mxr_nd_set", as.integer(r$id), as.double(values),
+                 as.integer(length(values)), status = integer(1)))
+  structure(r$id, class = "mxtpu.ndarray")
+}
+
+mx.model.save <- function(model, prefix, iteration) {
+  json <- mx.symbol.tojson(model$symbol)
+  writeLines(json, paste0(prefix, "-symbol.json"))
+  ids <- integer(0)
+  names <- character(0)
+  for (i in seq_along(model$arg_names)) {
+    nm <- model$arg_names[i]
+    if (nm == "data" || grepl("label", nm)) next
+    ids <- c(ids, model$args[i])
+    names <- c(names, paste0("arg:", nm))
+  }
+  if (!is.null(model$aux_names) && length(model$aux_names) > 0) {
+    for (i in seq_along(model$aux_names)) {
+      ids <- c(ids, model$auxs[i])
+      names <- c(names, paste0("aux:", model$aux_names[i]))
+    }
+  }
+  fname <- sprintf("%s-%04d.params", prefix, iteration)
+  invisible(.mxr.status(.C("mxr_nd_save", as.character(fname),
+                           as.integer(length(ids)), as.integer(ids),
+                           as.character(names), status = integer(1))))
+}
+
+# returns list(symbol, arg_names, args (nd ids incl. fresh data/label
+# slots = 0), aux_names, auxs): enough to rebuild an executor via
+# mx.model.bind or predict via mx.model.predict after re-binding.
+mx.model.load <- function(prefix, iteration) {
+  json <- paste(readLines(paste0(prefix, "-symbol.json")), collapse = "\n")
+  symbol <- mx.symbol.fromjson(json)
+  fname <- sprintf("%s-%04d.params", prefix, iteration)
+  buf <- paste(rep(" ", 65536L), collapse = "")
+  r <- .mxr.status(.C("mxr_nd_load", as.character(fname), 1024L,
+                      n = integer(1), ids = integer(1024),
+                      names = as.character(buf), as.integer(65536L),
+                      status = integer(1)))
+  names <- strsplit(r$names, "\n")[[1]]
+  ids <- r$ids[seq_len(r$n)]
+  arg_params <- list()
+  aux_params <- list()
+  for (i in seq_len(r$n)) {
+    nm <- names[i]
+    if (startsWith(nm, "arg:")) {
+      arg_params[[substring(nm, 5)]] <- ids[i]
+    } else if (startsWith(nm, "aux:")) {
+      aux_params[[substring(nm, 5)]] <- ids[i]
+    }
+  }
+  list(symbol = symbol, arg_params = arg_params, aux_params = aux_params)
+}
+
+# Train `symbol` on X (R dim order, sample axis LAST) / y. The kv argument
+# accepts NULL (single-process) or an mxtpu.kvstore: gradients are then
+# push/pulled through the store before the optimizer step, the multi-worker
+# aggregation path (reference model.R kvstore=TRUE route).
+mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
+                                        num.round = 10, learning.rate = 0.1,
+                                        momentum = 0.9, wd = 0,
+                                        initializer.scale = 0.1,
+                                        kv = NULL, verbose = TRUE) {
+  iter <- mx.io.NDArrayIter(X, y, batch.size = batch.size)
+  nd <- length(dim(X))
+  data_shape <- c(batch.size, rev(dim(X)[-nd]))
+
+  arg_names <- mx.symbol.arguments(symbol)
+  shapes <- mx.symbol.infer.shapes(symbol, data_shape)
+
+  args <- integer(length(arg_names))
+  grads <- integer(length(arg_names))
+  reqs <- integer(length(arg_names))
+  weight_ids <- list()
+  grad_ids <- list()
+  set.seed(0)
+  for (i in seq_along(arg_names)) {
+    shp <- shapes$arg_shapes[[i]]
+    nm <- arg_names[i]
+    nel <- prod(shp)
+    init <- if (grepl("weight", nm)) {
+      rnorm(nel) * initializer.scale
+    } else if (grepl("gamma", nm)) {
+      rep(1, nel)   # BatchNorm scale: zero would kill gradient flow
+    } else {
+      rep(0, nel)
+    }
+    args[i] <- .mxr.nd.from.host(shp, init)
+    if (nm == "data" || grepl("label", nm)) {
+      grads[i] <- 0L
+      reqs[i] <- 0L
+    } else {
+      grads[i] <- .mxr.nd.from.host(shp, rep(0, nel))
+      reqs[i] <- 1L
+      weight_ids[[length(weight_ids) + 1L]] <- args[i]
+      grad_ids[[length(grad_ids) + 1L]] <- grads[i]
+    }
+  }
+  aux_names <- mx.symbol.aux(symbol)
+  auxs <- integer(0)
+  if (length(aux_names) > 0) {
+    auxs <- vapply(seq_along(aux_names), function(i) {
+      shp <- shapes$aux_shapes[[i]]
+      init <- if (grepl("var", aux_names[i])) rep(1, prod(shp))
+              else rep(0, prod(shp))
+      .mxr.nd.from.host(shp, init)
+    }, integer(1))
+  }
+
+  ex <- mx.executor.bind(symbol, args, grads, reqs, auxs)
+  data_idx <- which(arg_names == "data")
+  label_idx <- which(grepl("label", arg_names))
+
+  optimizer <- mx.opt.create("sgd", learning.rate = learning.rate,
+                             momentum = momentum, wd = wd,
+                             rescale.grad = 1 / batch.size)
+  updater <- mx.opt.get.updater(optimizer, weight_ids)
+  if (!is.null(kv)) {
+    mx.kv.init(kv, seq_along(weight_ids) - 1L, weight_ids)
+  }
+
+  acc <- 0
+  for (round in seq_len(num.round)) {
+    correct <- 0
+    seen <- 0
+    iter$reset()
+    while (iter$iter.next()) {
+      b <- iter$value()
+      # b$data is features-by-batch: as.double flattens it straight into
+      # the runtime's row-major (batch, features...) layout (see io.R)
+      .mxr.status(.C("mxr_nd_set", as.integer(args[data_idx]),
+                     as.double(b$data), as.integer(length(b$data)),
+                     status = integer(1)))
+      .mxr.status(.C("mxr_nd_set", as.integer(args[label_idx]),
+                     as.double(b$label), as.integer(batch.size),
+                     status = integer(1)))
+      mx.executor.forward(ex, is.train = TRUE)
+      outs <- mx.executor.outputs(ex)
+      prob <- as.array.mxtpu.ndarray(outs[[1]])  # batch x classes
+      keep <- batch.size - b$pad
+      pred <- max.col(prob)[seq_len(keep)] - 1
+      correct <- correct + sum(pred == b$label[seq_len(keep)])
+      seen <- seen + keep
+      for (o in outs) mx.nd.free(o)
+      mx.executor.backward(ex)
+      if (!is.null(kv)) {
+        # aggregate gradients across workers through the store, then the
+        # local optimizer applies the combined gradient (update-on-worker)
+        mx.kv.push(kv, seq_along(grad_ids) - 1L, grad_ids)
+        mx.kv.pull(kv, seq_along(grad_ids) - 1L, grad_ids)
+      }
+      updater(weight_ids, grad_ids)
+    }
+    if (verbose)
+      message(sprintf("Round [%d] train accuracy: %.4f", round,
+                      correct / seen))
+    acc <- correct / seen
+  }
+  structure(list(executor = ex, arg_names = arg_names, args = args,
+                 aux_names = aux_names, auxs = auxs,
+                 symbol = symbol, train_acc = acc),
+            class = "mxtpu.model")
+}

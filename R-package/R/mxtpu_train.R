@@ -186,123 +186,11 @@ mx.executor.outputs <- function(ex) {
 }
 
 # -------------------------------------------------------------- FeedForward
-
-# mx.model.FeedForward.create: train `symbol` on X (array, R dim order with
-# the sample axis LAST, e.g. 28x28x1xN) / y (labels), plain SGD + momentum.
-# Reference: R-package/R/model.R mx.model.FeedForward.create.
-mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
-                                        num.round = 10, learning.rate = 0.1,
-                                        momentum = 0.9, wd = 0,
-                                        initializer.scale = 0.1,
-                                        verbose = TRUE) {
-  nd <- length(dim(X))
-  n <- dim(X)[nd]
-  data_shape <- c(batch.size, rev(dim(X)[-nd]))  # row-major (N, ...)
-
-  arg_names <- mx.symbol.arguments(symbol)
-  shapes <- mx.symbol.infer.shapes(symbol, data_shape)
-
-  args <- integer(length(arg_names))
-  grads <- integer(length(arg_names))
-  reqs <- integer(length(arg_names))
-  moms <- list()
-  set.seed(0)
-  for (i in seq_along(arg_names)) {
-    shp <- shapes$arg_shapes[[i]]
-    r <- .mxr.status(.C("mxr_nd_create", as.integer(shp),
-                        as.integer(length(shp)), id = integer(1),
-                        status = integer(1)))
-    args[i] <- r$id
-    nm <- arg_names[i]
-    nel <- prod(shp)
-    init <- if (grepl("weight", nm)) {
-      rnorm(nel) * initializer.scale
-    } else if (grepl("gamma", nm)) {
-      rep(1, nel)   # BatchNorm scale: zero would kill gradient flow
-    } else {
-      rep(0, nel)
-    }
-    .mxr.status(.C("mxr_nd_set", as.integer(args[i]), as.double(init),
-                   as.integer(nel), status = integer(1)))
-    if (nm %in% c("data") || grepl("label", nm)) {
-      grads[i] <- 0L
-      reqs[i] <- 0L
-    } else {
-      g <- .mxr.status(.C("mxr_nd_create", as.integer(shp),
-                          as.integer(length(shp)), id = integer(1),
-                          status = integer(1)))
-      grads[i] <- g$id
-      reqs[i] <- 1L
-      moms[[nm]] <- rep(0, nel)
-    }
-  }
-  aux_names <- mx.symbol.aux(symbol)
-  auxs <- integer(0)
-  if (length(aux_names) > 0) {
-    auxs <- vapply(seq_along(aux_names), function(i) {
-      shp <- shapes$aux_shapes[[i]]
-      r <- .mxr.status(.C("mxr_nd_create", as.integer(shp),
-                          as.integer(length(shp)), id = integer(1),
-                          status = integer(1)))
-      init <- if (grepl("var", aux_names[i])) rep(1, prod(shp))
-              else rep(0, prod(shp))
-      .mxr.status(.C("mxr_nd_set", as.integer(r$id), as.double(init),
-                     as.integer(prod(shp)), status = integer(1)))
-      r$id
-    }, integer(1))
-  }
-
-  ex <- mx.executor.bind(symbol, args, grads, reqs, auxs)
-  data_idx <- which(arg_names == "data")
-  label_idx <- which(grepl("label", arg_names))
-
-  Xflat <- array(X, dim = c(prod(dim(X)[-nd]), n))  # features x N
-  for (round in seq_len(num.round)) {
-    correct <- 0
-    seen <- 0
-    for (start in seq(1, n - batch.size + 1, by = batch.size)) {
-      idx <- start:(start + batch.size - 1)
-      # row-major batch: sample-major ordering
-      batch <- t(Xflat[, idx])
-      .mxr.status(.C("mxr_nd_set", as.integer(args[data_idx]),
-                     as.double(t(batch)), as.integer(length(batch)),
-                     status = integer(1)))
-      .mxr.status(.C("mxr_nd_set", as.integer(args[label_idx]),
-                     as.double(y[idx]), as.integer(batch.size),
-                     status = integer(1)))
-      mx.executor.forward(ex, is.train = TRUE)
-      outs <- mx.executor.outputs(ex)
-      prob <- as.array.mxtpu.ndarray(outs[[1]])  # batch x classes
-      pred <- max.col(prob) - 1
-      correct <- correct + sum(pred == y[idx])
-      seen <- seen + batch.size
-      for (o in outs) mx.nd.free(o)
-      mx.executor.backward(ex)
-      for (i in seq_along(arg_names)) {
-        if (reqs[i] == 0) next
-        nm <- arg_names[i]
-        nel <- length(moms[[nm]])
-        g <- .mxr.status(.C("mxr_nd_get", as.integer(grads[i]),
-                            data = double(nel), as.integer(nel),
-                            status = integer(1)))$data
-        w <- .mxr.status(.C("mxr_nd_get", as.integer(args[i]),
-                            data = double(nel), as.integer(nel),
-                            status = integer(1)))$data
-        moms[[nm]] <- momentum * moms[[nm]] +
-          (g / batch.size + wd * w)
-        w <- w - learning.rate * moms[[nm]]
-        .mxr.status(.C("mxr_nd_set", as.integer(args[i]), as.double(w),
-                       as.integer(nel), status = integer(1)))
-      }
-    }
-    if (verbose)
-      message(sprintf("Round [%d] train accuracy: %.4f", round,
-                      correct / seen))
-  }
-  structure(list(executor = ex, arg_names = arg_names, args = args,
-                 symbol = symbol, train_acc = correct / seen),
-            class = "mxtpu.model")
-}
+#
+# mx.model.FeedForward.create / mx.model.save / mx.model.load moved to
+# model.R (training now routes through optimizer.R's framework-resident
+# updater and io.R's NDArrayIter; checkpoints are format-compatible with
+# the Python layer). mx.model.predict stays here with the executor layer.
 
 # forward-only prediction on a trained model (batch.size must divide N)
 mx.model.predict <- function(model, X, batch.size = 32) {

@@ -12,6 +12,10 @@
 
 dyn.load(file.path("src", "libmxtpu_r_train.so"))
 source(file.path("R", "mxtpu_train.R"))
+source(file.path("R", "optimizer.R"))
+source(file.path("R", "io.R"))
+source(file.path("R", "kvstore.R"))
+source(file.path("R", "model.R"))
 
 mx.r.seed(0)
 
@@ -45,11 +49,20 @@ net <- mx.symbol.SoftmaxOutput(data = fc2, name = "softmax")
 cat("arguments:", paste(mx.symbol.arguments(net), collapse = ", "), "\n")
 
 # --- train ------------------------------------------------------------------
+# gradients round through the kvstore (aggregation path) and the optimizer
+# update runs inside the runtime via registered NDArray functions
+kv <- mx.kv.create("local")
 model <- mx.model.FeedForward.create(net, X, y, batch.size = 32,
                                      num.round = 8, learning.rate = 0.1,
-                                     momentum = 0.9)
+                                     momentum = 0.9, kv = kv)
 
 stopifnot(model$train_acc > 0.9)
+
+# --- checkpoint round-trip (format-compatible with the Python layer) --------
+mx.model.save(model, file.path(tempdir(), "lenet_r"), 8)
+loaded <- mx.model.load(file.path(tempdir(), "lenet_r"), 8)
+stopifnot(length(loaded$arg_params) == 6)  # c1/fc1/fc2 weight+bias
+cat("checkpoint save/load round-trip OK\n")
 
 # --- predict + symbol JSON round-trip ---------------------------------------
 prob <- mx.model.predict(model, X, batch.size = 32)  # N x classes

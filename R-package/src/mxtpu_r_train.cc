@@ -244,6 +244,132 @@ void mxr_sym_infer_shapes(int* id, char** data_name, int* data_shape,
   }
 }
 
+/* --------------------------------------------- checkpoint (nd save/load) */
+
+// save named arrays to `fname` in the framework's checkpoint container —
+// the SAME file format Python's mx.nd.save / model save_checkpoint writes,
+// so R-side mx.model.save round-trips with Python FeedForward.load
+// (reference capability: R-package/R/model.R mx.model.save -> mx.nd.save).
+void mxr_nd_save(char** fname, int* n, int* ids, char** names,
+                 int* status) {
+  std::vector<NDArrayHandle> hs(*n);
+  std::vector<const char*> ks(*n);
+  for (int i = 0; i < *n; ++i) {
+    hs[i] = get_handle(ids[i]);
+    ks[i] = names[i];
+  }
+  *status = record(
+      MXNDArraySave(fname[0], (mx_uint)*n, hs.data(), ks.data()));
+}
+
+// load a checkpoint container: ids into ids_out, names '\n'-joined into
+// the caller's buffer (cap = id slots; name_cap = name buffer bytes)
+void mxr_nd_load(char** fname, int* cap, int* n_out, int* ids_out,
+                 char** names_out, int* name_cap, int* status) {
+  mx_uint n, n_names;
+  NDArrayHandle* hs;
+  const char** names;
+  *status = record(MXNDArrayLoad(fname[0], &n, &hs, &n_names, &names));
+  if (*status != 0) return;
+  if ((int)n > *cap || n_names != n) {
+    g_last_error = "mxr_nd_load: more arrays than caller capacity (or "
+                   "unnamed entries; R checkpoints are always named)";
+    *status = -1;
+    return;
+  }
+  std::string joined;
+  for (mx_uint i = 0; i < n; ++i) {
+    if (i) joined += '\n';
+    joined += names[i];
+  }
+  if ((int)joined.size() >= *name_cap) {
+    // truncating mid-name would hand R fewer/corrupt names than ids —
+    // a silently mis-keyed model load; fail loudly instead (nothing was
+    // registered yet, so no handle-table entries leak; the arrays
+    // themselves are freed here)
+    for (mx_uint i = 0; i < n; ++i) MXNDArrayFree(hs[i]);
+    g_last_error = "mxr_nd_load: joined parameter names exceed the "
+                   "caller-provided name buffer; raise name_cap in "
+                   "mx.model.load";
+    *status = -1;
+    return;
+  }
+  *n_out = (int)n;
+  for (mx_uint i = 0; i < n; ++i) ids_out[i] = put_handle(hs[i]);
+  std::strncpy(*names_out, joined.c_str(), *name_cap - 1);
+  (*names_out)[*name_cap - 1] = '\0';
+}
+
+/* ------------------------------------- function registry (ndarray math) */
+
+// invoke a registered NDArray function (MXFuncInvoke) — this is how the R
+// optimizer layer runs its update math INSIDE the framework (XLA ops on
+// runtime-resident arrays) instead of on R doubles, mirroring the
+// reference's R optimizer over mx.nd arithmetic
+// (reference: R-package/R/optimizer.R update() on mx.nd ops).
+void mxr_func_invoke(char** fname, int* n_use, int* use_ids, int* n_scalar,
+                     double* scalars, int* n_mutate, int* mutate_ids,
+                     int* status) {
+  FunctionHandle f;
+  *status = record(MXGetFunction(fname[0], &f));
+  if (*status != 0) return;
+  std::vector<NDArrayHandle> use(*n_use), mut(*n_mutate);
+  for (int i = 0; i < *n_use; ++i) use[i] = get_handle(use_ids[i]);
+  for (int i = 0; i < *n_mutate; ++i) mut[i] = get_handle(mutate_ids[i]);
+  std::vector<mx_float> sc(*n_scalar);
+  for (int i = 0; i < *n_scalar; ++i) sc[i] = (mx_float)scalars[i];
+  *status = record(MXFuncInvoke(f, use.data(), sc.data(), mut.data()));
+}
+
+/* -------------------------------------------------------------- kvstore */
+
+void mxr_kv_create(char** type, int* id_out, int* status) {
+  KVStoreHandle h;
+  *status = record(MXKVStoreCreate(type[0], &h));
+  if (*status == 0) *id_out = put_handle(h);
+}
+
+void mxr_kv_free(int* id, int* status) {
+  void* h = get_handle(*id);
+  g_handles.erase(*id);
+  *status = record(MXKVStoreFree(h));
+}
+
+void mxr_kv_init(int* kv, int* n, int* keys, int* nd_ids, int* status) {
+  std::vector<NDArrayHandle> vals(*n);
+  for (int i = 0; i < *n; ++i) vals[i] = get_handle(nd_ids[i]);
+  *status = record(
+      MXKVStoreInit(get_handle(*kv), (mx_uint)*n, keys, vals.data()));
+}
+
+void mxr_kv_push(int* kv, int* n, int* keys, int* nd_ids, int* priority,
+                 int* status) {
+  std::vector<NDArrayHandle> vals(*n);
+  for (int i = 0; i < *n; ++i) vals[i] = get_handle(nd_ids[i]);
+  *status = record(MXKVStorePush(get_handle(*kv), (mx_uint)*n, keys,
+                                 vals.data(), *priority));
+}
+
+void mxr_kv_pull(int* kv, int* n, int* keys, int* nd_ids, int* priority,
+                 int* status) {
+  std::vector<NDArrayHandle> vals(*n);
+  for (int i = 0; i < *n; ++i) vals[i] = get_handle(nd_ids[i]);
+  *status = record(MXKVStorePull(get_handle(*kv), (mx_uint)*n, keys,
+                                 vals.data(), *priority));
+}
+
+void mxr_kv_rank(int* kv, int* rank_out, int* status) {
+  *status = record(MXKVStoreGetRank(get_handle(*kv), rank_out));
+}
+
+void mxr_kv_size(int* kv, int* size_out, int* status) {
+  *status = record(MXKVStoreGetGroupSize(get_handle(*kv), size_out));
+}
+
+void mxr_kv_barrier(int* kv, int* status) {
+  *status = record(MXKVStoreBarrier(get_handle(*kv)));
+}
+
 /* ------------------------------------------------------------ executor */
 
 void mxr_exec_bind(int* sym_id, int* n, int* arg_ids, int* grad_ids,

@@ -52,6 +52,50 @@ def _data_shape(batch_size, layout):
         (batch_size, 3, 224, 224)
 
 
+def probe_backend_init(timeout_s=None, tries=3):
+    """Init-stage tunnel guard. The r03/r04 driver captures died INSIDE
+    make_c_api_client — before any compile — so retrying ops (with_retries)
+    or warming the compile cache cannot save a capture whose backend never
+    comes up. A wedged in-process init can only be abandoned by killing the
+    process, so the probe runs `jax.devices()` in a SUBPROCESS with a hard
+    timeout and backs off between attempts; only once a probe succeeds does
+    the main process commit to its own (now very likely healthy) init.
+
+    Returns True when a probe succeeded; False when every attempt timed out
+    or crashed (callers should exit rc=3 immediately instead of eating the
+    driver's whole timeout budget)."""
+    import subprocess
+
+    if timeout_s is None:
+        timeout_s = int(os.environ.get("MXTPU_INIT_PROBE_TIMEOUT_SEC", "150"))
+    code = ("import jax, time; t0=time.time(); d=jax.devices(); "
+            "print('probe ok:', d[0].platform, len(d), "
+            "'init_s=%.1f' % (time.time()-t0))")
+    delays = [30, 90]
+    for attempt in range(tries):
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               timeout=timeout_s, capture_output=True,
+                               text=True)
+            if r.returncode == 0:
+                print(f"backend init probe: {r.stdout.strip()} "
+                      f"(attempt {attempt + 1})", file=sys.stderr)
+                return True
+            detail = (r.stderr or r.stdout).strip().splitlines()
+            detail = detail[-1][:160] if detail else f"rc={r.returncode}"
+            print(f"backend init probe failed (attempt {attempt + 1}/"
+                  f"{tries}): {detail}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"backend init probe TIMED OUT after {timeout_s}s "
+                  f"(attempt {attempt + 1}/{tries}) — tunnel wedged at "
+                  "client init (the r03/r04 failure mode)", file=sys.stderr)
+        if attempt < tries - 1:
+            delay = delays[min(attempt, len(delays) - 1)]
+            print(f"backing off {delay}s before re-probing", file=sys.stderr)
+            time.sleep(delay)
+    return False
+
+
 def with_retries(fn, tries=4, what="tpu op"):
     """Retry transient tunnel failures (the round-2 bench died rc=1 on a
     wedged compile service; UNAVAILABLE from the axon backend is retryable)."""
@@ -322,6 +366,14 @@ def main():
         run_pipeline_bench(args)
         timer.cancel()
         return
+    # Init-stage guard BEFORE any remaining mode touches jax.devices():
+    # a wedged client init is unrecoverable in-process (r03/r04
+    # post-mortem). pipeline mode returned above — it forces CPU.
+    if not probe_backend_init():
+        print("bench: backend init unreachable after retries; exiting rc=3 "
+              "(tunnel wedged at client init)", file=sys.stderr)
+        os._exit(3)
+
     if args.mode == "io":
         run_io_bench(args)
         return

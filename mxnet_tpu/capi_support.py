@@ -386,7 +386,20 @@ class CApi:
             kv.init(int(k), v)
 
     def kv_push(self, kv, keys, vals, priority):
-        kv.push([int(k) for k in keys], list(vals), priority=int(priority))
+        # the reference C API groups repeated keys within one push call
+        # (GroupKVPairs, kvstore_local.h): push([k,k],[a,b]) merges a+b.
+        # The Python-level store takes one value (or an explicit list) per
+        # key, so regroup here at the C boundary.
+        groups, order = {}, []
+        for k, v in zip([int(k) for k in keys], vals):
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(v)
+        kv.push(order,
+                [g[0] if len(g) == 1 else g
+                 for g in (groups[k] for k in order)],
+                priority=int(priority))
 
     def kv_pull(self, kv, keys, outs, priority):
         kv.pull([int(k) for k in keys], list(outs), priority=int(priority))

@@ -139,7 +139,21 @@ def _place(value, sharding):
 
     Under jax.distributed a plain device_put cannot target non-addressable
     devices; each process contributes its local value as its part of the
-    global array instead (its batch shard, or its replica copy)."""
+    global array instead (its batch shard, or its replica copy).
+
+    Values that are ALREADY global jax.Arrays (the async feed pre-places
+    batches) pass through: np.asarray on an array spanning non-addressable
+    devices raises, and the re-place would be wasted work anyway."""
+    if isinstance(value, jax.Array):
+        try:
+            if value.sharding.is_equivalent_to(sharding, value.ndim):
+                return value
+        except Exception:  # pragma: no cover - defensive; differing mesh objs
+            pass
+        if not value.is_fully_addressable:
+            # global array under a different sharding: reshard on device —
+            # fetching to host across processes is impossible by definition
+            return jax.device_put(value, sharding)
     if jax.process_count() > 1:
         return jax.make_array_from_process_local_data(sharding,
                                                       np.asarray(value))
@@ -163,11 +177,19 @@ class _AsyncDeviceFeed:
     fast iterator cannot queue an epoch of device buffers. Iterator
     exceptions surface in the consuming thread. Disable with
     MXTPU_FEED_PREFETCH=0 (the fit loop then feeds synchronously).
+
+    Buffer-reuse contract: the feed runs up to ``depth`` batches ahead, and
+    device_put may read the host buffers asynchronously, so iterators feeding
+    fit must hand over FRESH data arrays per batch (every in-repo iterator
+    does; an iterator recycling one buffer, reference ThreadedIter-style,
+    would corrupt in-flight transfers). Labels are defensively copied by
+    ``snapshot`` in fit — they are retained far longer (until the metric
+    update after the step completes) than the data transfer window.
     """
 
     _SENTINEL = object()
 
-    def __init__(self, data_iter, extract, place, depth=2):
+    def __init__(self, data_iter, extract, place, depth=2, snapshot=None):
         import queue
         import threading
 
@@ -180,7 +202,10 @@ class _AsyncDeviceFeed:
                 for batch in data_iter:
                     # place() dispatches the async device_put; the consumer
                     # gets arrays whose transfer is already in flight
-                    item = (batch, place(extract(batch)))
+                    placed = place(extract(batch))
+                    if snapshot is not None:
+                        batch = snapshot(batch)
+                    item = (batch, placed)
                     while not self._closed:
                         try:
                             self._q.put(item, timeout=0.2)
@@ -218,6 +243,11 @@ class _AsyncDeviceFeed:
             except Exception:  # pragma: no cover - drained concurrently
                 break
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():  # pragma: no cover - hung data_iter.next
+            logging.warning(
+                "mxtpu-device-feed worker still running after close() "
+                "(data iterator blocked in next()); resetting the iterator "
+                "now may race the feed thread")
 
     def __iter__(self):
         while True:
@@ -227,6 +257,29 @@ class _AsyncDeviceFeed:
                     raise self._err
                 return
             yield item
+
+
+class _FeedBatchView:
+    """Consumer-side view of a prefetched batch whose labels were copied out
+    of the iterator's buffers (see _AsyncDeviceFeed buffer-reuse contract:
+    labels are read for the metric update only after the step runs, well
+    past the window in which a recycling iterator may rewrite them)."""
+
+    __slots__ = ("_batch", "label")
+
+    def __init__(self, batch, label):
+        self._batch = batch
+        self.label = label
+
+    def __getattr__(self, name):
+        return getattr(self._batch, name)
+
+
+def _snapshot_batch(batch):
+    label = [NDArray(np.array(l.data, copy=True))
+             if isinstance(getattr(l, "data", None), np.ndarray) else l
+             for l in batch.label]
+    return _FeedBatchView(batch, label)
 
 
 def _create_kvstore(kvstore, num_device, arg_params):
@@ -629,7 +682,8 @@ class FeedForward(BASE_ESTIMATOR):
             train_data.reset()
             if feed_depth > 0:
                 feed = _AsyncDeviceFeed(train_data, _extract_batch,
-                                        _place_batch, depth=feed_depth)
+                                        _place_batch, depth=feed_depth,
+                                        snapshot=_snapshot_batch)
             else:  # MXTPU_FEED_PREFETCH=0: synchronous feed (debugging)
                 feed = ((b, _place_batch(_extract_batch(b)))
                         for b in train_data)

@@ -45,11 +45,34 @@ def test_dist_sync_mlp_2proc():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    # pin the async device feed ON: this tier is what caught the round-4
+    # double-_place regression (global arrays re-placed via np.asarray)
+    env["MXTPU_FEED_PREFETCH"] = "2"
     res = subprocess.run(
         [sys.executable, LAUNCH, "-n", "2", sys.executable, script],
         capture_output=True, text=True, timeout=300, env=env)
     assert res.returncode == 0, res.stderr[-2000:]
     assert res.stdout.count("dist_sync_mlp accuracy") == 2, res.stdout
+
+
+@pytest.mark.slow
+def test_dist_sync_lenet_2proc():
+    """Launched CONV-NET train-to-accuracy tier (reference:
+    multi-node/dist_sync_lenet.py): 2 real processes, LeNet on deterministic
+    4-class images, BSP-synced conv gradients, accuracy asserted on every
+    worker."""
+    script = os.path.join(REPO, "examples", "distributed",
+                          "dist_sync_lenet.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["MXTPU_FEED_PREFETCH"] = "2"  # overlap feed stays on multi-process
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", sys.executable, script],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert res.stdout.count("dist_sync_lenet accuracy") == 2, \
+        res.stdout + res.stderr[-2000:]
 
 
 @pytest.mark.slow

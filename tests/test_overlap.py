@@ -112,12 +112,13 @@ def test_fit_overlaps_feed_and_compute():
     # The synchronous feed really costs the sum (sanity: the rig's sleeps
     # are doing their job) ...
     assert t_sync > 0.9 * sum_floor, (t_sync, sum_floor)
-    # ... and the overlapped feed is max()-shaped: clearly below the serial
-    # floor and within overhead margin of the max floor. The 0.8 factor
-    # leaves room for per-batch dispatch overhead on slow CI hosts while
-    # still being impossible for a non-overlapping loop (which pays
-    # >= 0.9 * sum_floor, see above).
-    assert t_overlap < 0.8 * sum_floor, (
+    # ... and the overlapped feed is max()-shaped: clearly below the
+    # measured serial epoch. The bound is RELATIVE to t_sync (not the
+    # sleep-derived floor) so a loaded CI host slows both measurements
+    # together instead of flaking the absolute arithmetic; 0.75 is
+    # impossible for a non-overlapping loop (which pays the same serial
+    # cost as t_sync) yet leaves wide margin over the ~0.5 ideal.
+    assert t_overlap < 0.75 * t_sync, (
         f"no feed/compute overlap: epoch took {t_overlap:.3f}s vs serial "
-        f"floor {sum_floor:.3f}s (max floor {max_floor:.3f}s)")
-    assert t_overlap < t_sync, (t_overlap, t_sync)
+        f"epoch {t_sync:.3f}s (serial floor {sum_floor:.3f}s, max floor "
+        f"{max_floor:.3f}s)")

@@ -205,6 +205,9 @@ def train_shim():
     subprocess.run(["make", "-C", capi_dir, "capi", "-s"],
                    capture_output=True, timeout=300)
     so = os.path.join(ROOT, "R-package", "src", "libmxtpu_r_train.so")
+    src = os.path.join(ROOT, "R-package", "src", "mxtpu_r_train.cc")
+    if os.path.exists(so) and os.path.getmtime(so) < os.path.getmtime(src):
+        os.remove(so)  # stale build: shim source is newer
     if not os.path.exists(so):
         r = subprocess.run(
             ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
@@ -386,3 +389,171 @@ def test_r_train_demo_under_rscript(train_shim):
                        cwd=os.path.join(ROOT, "R-package"))
     assert r.returncode == 0, r.stderr[-2000:]
     assert "train accuracy" in (r.stdout + r.stderr)
+
+
+# ---------------------------------------------------------------------------
+# Round-5 widening (VERDICT r4 item 6): checkpoint save/load through the
+# shim (format parity with Python), kvstore surface, and the registered-
+# function route the R optimizer layer uses — each driven with the exact
+# .C pointer convention the new R files (model.R/kvstore.R/optimizer.R)
+# emit.
+
+def _shim_nd_helpers(lib):
+    def nd_create(shape):
+        out, st = _p_int(0), _p_int(1)
+        lib.mxr_nd_create(_p_int(*shape), _p_int(len(shape)), out, st)
+        _st(lib, None, st)
+        return out[0]
+
+    def nd_set(h, arr):
+        arr = np.ascontiguousarray(arr, np.float64).ravel()
+        st = _p_int(1)
+        lib.mxr_nd_set(_p_int(h),
+                       arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                       _p_int(arr.size), st)
+        _st(lib, None, st)
+
+    def nd_get(h, n):
+        buf = np.empty(n, np.float64)
+        st = _p_int(1)
+        lib.mxr_nd_get(_p_int(h),
+                       buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                       _p_int(n), st)
+        _st(lib, None, st)
+        return buf
+
+    return nd_create, nd_set, nd_get
+
+
+def test_r_shim_nd_save_load_python_roundtrip(train_shim, tmp_path):
+    """mx.model.save writes the SAME container Python mx.nd.load reads —
+    and vice versa (reference parity: R-package/R/model.R mx.model.save /
+    mxnet_tpu/model.py:63-85)."""
+    lib = train_shim
+    nd_create, nd_set, nd_get = _shim_nd_helpers(lib)
+    rng = np.random.RandomState(3)
+
+    # R -> Python
+    w = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    hw, hb = nd_create([4, 3]), nd_create([3])
+    nd_set(hw, w)
+    nd_set(hb, b)
+    fname = str(tmp_path / "rsave.params")
+    st = _p_int(1)
+    lib.mxr_nd_save(_p_str(fname), _p_int(2), _p_int(hw, hb),
+                    _p_str("arg:fc_weight", "arg:fc_bias"), st)
+    _st(lib, None, st)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"arg:fc_weight", "arg:fc_bias"}
+    np.testing.assert_allclose(loaded["arg:fc_weight"].asnumpy(), w,
+                               atol=1e-6)
+    np.testing.assert_allclose(loaded["arg:fc_bias"].asnumpy(), b,
+                               atol=1e-6)
+
+    # Python -> R
+    fname2 = str(tmp_path / "pysave.params")
+    nd.save(fname2, {"aux:mean": nd.array(w), "arg:scale": nd.array(b)})
+    n_out = _p_int(0)
+    ids = (ctypes.c_int * 16)()
+    buf = ctypes.create_string_buffer(1 << 12)
+    pbuf = ctypes.cast(ctypes.pointer(ctypes.c_char_p(ctypes.addressof(buf))),
+                       ctypes.POINTER(ctypes.c_char_p))
+    st = _p_int(1)
+    lib.mxr_nd_load(_p_str(fname2), _p_int(16), n_out, ids, pbuf,
+                    _p_int(1 << 12), st)
+    _st(lib, None, st)
+    assert n_out[0] == 2
+    names = buf.value.decode().split("\n")
+    by_name = {names[i]: ids[i] for i in range(2)}
+    np.testing.assert_allclose(
+        nd_get(by_name["aux:mean"], 12).reshape(4, 3), w, atol=1e-6)
+    np.testing.assert_allclose(nd_get(by_name["arg:scale"], 3), b,
+                               atol=1e-6)
+
+
+def test_r_shim_func_invoke_optimizer_math(train_shim):
+    """The R optimizer's update math runs through MXFuncInvoke on
+    runtime-resident arrays (optimizer.R .mxr.func): verify the exact SGD
+    momentum sequence model.R drives gives the numpy closed form."""
+    lib = train_shim
+    nd_create, nd_set, nd_get = _shim_nd_helpers(lib)
+    rng = np.random.RandomState(7)
+    w = rng.randn(6).astype(np.float64)
+    g = rng.randn(6).astype(np.float64)
+    mom = np.zeros(6)
+    lr, momentum, rescale = 0.5, 0.9, 1 / 16.0
+
+    hw, hg = nd_create([6]), nd_create([6])
+    hmom, hscratch = nd_create([6]), nd_create([6])
+    nd_set(hw, w)
+    nd_set(hg, g)
+    nd_set(hmom, mom)
+
+    def func(name, use, scalars, mutate):
+        st = _p_int(1)
+        sc = (ctypes.c_double * max(1, len(scalars)))(*scalars)
+        lib.mxr_func_invoke(_p_str(name), _p_int(len(use)), _p_int(*use),
+                            _p_int(len(scalars)), sc, _p_int(1),
+                            _p_int(mutate), st)
+        _st(lib, None, st)
+
+    for _ in range(3):  # momentum accumulates over steps
+        # scratch = lr * rescale * grad ; mom = momentum*mom - scratch
+        func("_mul_scalar", [hg], [rescale], hscratch)
+        func("_mul_scalar", [hscratch], [lr], hscratch)
+        func("_mul_scalar", [hmom], [momentum], hmom)
+        func("_minus", [hmom, hscratch], [], hmom)
+        func("_plus", [hw, hmom], [], hw)
+        mom = momentum * mom - lr * (rescale * g)
+        w = w + mom
+
+    np.testing.assert_allclose(nd_get(hw, 6), w, atol=1e-5)
+    np.testing.assert_allclose(nd_get(hmom, 6), mom, atol=1e-5)
+
+
+def test_r_shim_kvstore(train_shim):
+    """mx.kv.* surface: init/push/pull aggregation on a local store plus
+    rank/size/barrier (reference: R-package/R/kvstore.R over MXKVStore*)."""
+    lib = train_shim
+    nd_create, nd_set, nd_get = _shim_nd_helpers(lib)
+
+    kv, st = _p_int(0), _p_int(1)
+    lib.mxr_kv_create(_p_str("local"), kv, st)
+    _st(lib, None, st)
+
+    h0 = nd_create([4])
+    nd_set(h0, np.arange(4.0))
+    st = _p_int(1)
+    lib.mxr_kv_init(_p_int(kv[0]), _p_int(1), _p_int(3), _p_int(h0), st)
+    _st(lib, None, st)
+
+    # one push with the key repeated: the C API groups repeated keys and
+    # the store merges (sums) the group — reference GroupKVPairs semantics
+    ha, hb, hout = nd_create([4]), nd_create([4]), nd_create([4])
+    nd_set(ha, np.ones(4))
+    nd_set(hb, 2 * np.ones(4))
+    st = _p_int(1)
+    lib.mxr_kv_push(_p_int(kv[0]), _p_int(2), _p_int(3, 3), _p_int(ha, hb),
+                    _p_int(0), st)
+    _st(lib, None, st)
+    st = _p_int(1)
+    lib.mxr_kv_pull(_p_int(kv[0]), _p_int(1), _p_int(3), _p_int(hout),
+                    _p_int(0), st)
+    _st(lib, None, st)
+    np.testing.assert_allclose(nd_get(hout, 4), 3 * np.ones(4), atol=1e-6)
+
+    rank, size = _p_int(-1), _p_int(-1)
+    st = _p_int(1)
+    lib.mxr_kv_rank(_p_int(kv[0]), rank, st)
+    _st(lib, None, st)
+    st = _p_int(1)
+    lib.mxr_kv_size(_p_int(kv[0]), size, st)
+    _st(lib, None, st)
+    assert rank[0] == 0 and size[0] == 1
+    st = _p_int(1)
+    lib.mxr_kv_barrier(_p_int(kv[0]), st)
+    _st(lib, None, st)
+    st = _p_int(1)
+    lib.mxr_kv_free(_p_int(kv[0]), st)
+    _st(lib, None, st)

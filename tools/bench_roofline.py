@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 
@@ -117,7 +118,101 @@ def step_traffic_bytes(batch_size, layout="NHWC"):
     ca = ca[0] if isinstance(ca, (list, tuple)) else ca
     return ({k: float(v) for k, v in ca.items()
              if isinstance(v, (int, float)) and ("bytes" in k or k == "flops")},
-            step, params, moms, aux, data, label)
+            compiled, step, params, moms, aux, data, label)
+
+
+_SHAPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+                "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8, "f64": 8,
+                "s16": 2, "u16": 2}
+
+
+def _shape_nbytes(shape_str):
+    """Bytes of one HLO shape token like 'bf16[256,56,56,64]{3,2,1,0}'
+    (layout suffix ignored; tuples handled by the caller)."""
+    m = re.match(r"([a-z]\d*|pred)\[([\d,]*)\]", shape_str)
+    if not m:
+        return 0
+    elem = _SHAPE_BYTES.get(m.group(1), 4)
+    n = 1
+    for d in filter(None, m.group(2).split(",")):
+        n *= int(d)
+    return elem * n
+
+
+def per_op_bytes_table(compiled, top_k=25):
+    """Rank the compiled step's instructions by HBM bytes accessed
+    (VERDICT r4 item 3: make the 21.4 GB excess attributable op by op).
+
+    XLA's aggregate 'bytes accessed' cost model charges each instruction
+    its operand bytes + output bytes (no cache modeling). The optimized
+    HLO text carries every instruction's output shape inline and its
+    operands by name, so the same accounting is reproducible per
+    instruction: parse name -> output shape, then charge each non-trivial
+    instruction sum(operand shapes) + output shape. Fusions are single
+    instructions here — exactly the granularity at which HBM traffic
+    happens on TPU (one fusion = one read of its operands + one write of
+    its outputs).
+
+    Returns (rows, totals_by_opcode): rows = [{name, opcode, gbytes,
+    shape}], both sorted desc."""
+    hlo = compiled.as_text()
+    # ENTRY computation only: fusion bodies (%fused_computation.N { ... })
+    # list their internal elementwise ops with the same line shape, but
+    # those never touch HBM — the enclosing fusion instruction in ENTRY is
+    # the HBM-traffic unit. Counting bodies would double-charge massively.
+    entry_lines = []
+    in_entry = False
+    for line in hlo.splitlines():
+        if line.startswith("ENTRY"):
+            in_entry = True
+            continue
+        if in_entry and line.startswith("}"):
+            break
+        if in_entry:
+            entry_lines.append(line)
+    # name -> output nbytes (tuple shapes: sum of leaves)
+    out_bytes = {}
+    inst_re = re.compile(
+        r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(\([^)]*\)|[a-z]\d*\[[^\]]*\]"
+        r"(?:\{[^}]*\})?)\s+([\w\-]+)\(")
+    insts = []
+    for line in entry_lines:
+        m = inst_re.match(line)
+        if not m:
+            continue
+        name, shape_s, opcode = m.groups()
+        if shape_s.startswith("("):
+            nbytes = sum(_shape_nbytes(s) for s in
+                         re.findall(r"[a-z]\d*\[[\d,]*\]", shape_s))
+        else:
+            nbytes = _shape_nbytes(shape_s)
+        out_bytes[name] = nbytes
+        insts.append((name, opcode, nbytes, shape_s, line))
+    # charge operands: tokens inside the call parens that name an ENTRY
+    # instruction (sigil-robust: newer XLA dumps omit the % prefix — the
+    # out_bytes membership test is what identifies operand references).
+    # parameter/constant/gte lines carry no traffic of their own (gte is
+    # a view; parameters are charged when a consumer reads them).
+    skip = {"parameter", "constant", "get-tuple-element", "tuple",
+            "bitcast"}
+    rows = []
+    for name, opcode, nbytes, shape_s, line in insts:
+        if opcode in skip:
+            continue
+        body = line.split("(", 1)[1]
+        ops = [t for t in re.findall(r"%?([\w.\-]+)", body)
+               if t in out_bytes]
+        total = nbytes + sum(out_bytes[o] for o in ops)
+        rows.append({"name": name, "opcode": opcode,
+                     "gbytes": total / 1e9,
+                     "shape": shape_s if len(shape_s) < 64 else
+                     shape_s[:61] + "..."})
+    rows.sort(key=lambda r: -r["gbytes"])
+    totals = {}
+    for r in rows:
+        totals[r["opcode"]] = totals.get(r["opcode"], 0.0) + r["gbytes"]
+    totals = dict(sorted(totals.items(), key=lambda kv: -kv[1]))
+    return rows[:top_k], totals
 
 
 def timed_step_ms(step, params, moms, aux, data, label, steps=16):
@@ -149,16 +244,54 @@ def timed_step_ms(step, params, moms, aux, data, label, steps=16):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=256)
-    ap.add_argument("--out", default="ROOFLINE_r03.json")
+    ap.add_argument("--out", default="ROOFLINE_r05.json")
+    ap.add_argument("--analyze-only", action="store_true",
+                    help="compile + per-op traffic table only (no timed "
+                         "runs; usable when the tunnel is compile-healthy "
+                         "but dispatch-wedged, or on the CPU backend)")
     args = ap.parse_args()
 
-    bw = with_retries(measured_hbm_bandwidth_gbs, what="hbm triad")
-    print(f"measured HBM triad bandwidth: {bw:.0f} GB/s")
+    import os
 
-    costs, step, params, moms, aux, data, label = step_traffic_bytes(
-        args.batch_size)
+    import jax
+
+    # the baked sitecustomize pins the axon TPU backend over the env var;
+    # honor JAX_PLATFORMS=cpu via live config (analyze-only dev runs)
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    if not args.analyze_only:
+        bw = with_retries(measured_hbm_bandwidth_gbs, what="hbm triad")
+        print(f"measured HBM triad bandwidth: {bw:.0f} GB/s")
+
+    costs, compiled, step, params, moms, aux, data, label = \
+        step_traffic_bytes(args.batch_size)
     traffic = costs.get("bytes accessed", 0.0)
     print(f"XLA bytes accessed per step: {traffic/1e9:.2f} GB")
+
+    top_rows, op_totals = per_op_bytes_table(compiled)
+    print("top HBM-traffic instructions (operand+output bytes):")
+    for r in top_rows[:15]:
+        print(f"  {r['gbytes']:7.3f} GB  {r['opcode']:<22} {r['name']}")
+    print("traffic by opcode:",
+          {k: round(v, 2) for k, v in list(op_totals.items())[:8]})
+
+    if args.analyze_only:
+        out = {
+            "batch_size": args.batch_size,
+            "xla_bytes_accessed_gb": round(traffic / 1e9, 3),
+            "analytic_min_traffic_gb": round(
+                analytic_min_traffic_gb(args.batch_size), 2),
+            "per_op_top": top_rows,
+            "per_opcode_gb": {k: round(v, 3) for k, v in op_totals.items()},
+        }
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.out} (analyze-only)")
+        return
 
     ms = with_retries(lambda: timed_step_ms(step, params, moms, aux, data,
                                             label), what="train step")
@@ -181,6 +314,8 @@ def main():
         "memory_floor_xla_traffic_ms": round(floor_xla_ms, 2),
         "compute_floor_ms_at_matmul_peak": round(floor_flops_ms, 2),
         "step_vs_ideal_memory_floor": round(ms / floor_ideal_ms, 3),
+        "per_op_top": top_rows,
+        "per_opcode_gb": {k: round(v, 3) for k, v in op_totals.items()},
         "verdict": (
             "bandwidth-bound: memory floors (ideal %.0f ms / xla-traffic "
             "%.0f ms) dominate the %.0f ms compute floor; measured step is "

@@ -1,0 +1,163 @@
+"""Projected 8->256-chip scaling efficiency (VERDICT r4 item 4).
+
+The rig has ONE real chip, so the 8->256 story the reference publishes as a
+measured table (/root/reference/tests/python/multi-node/README.md:269-311,
+>=90% efficiency north star in BASELINE.json) is built here as a clearly
+labeled PROJECTION from two verifiable inputs:
+
+1. collective bytes/step — extracted from the compiled HLO of the actual
+   data-parallel ResNet-50 train step over a virtual mesh (the SPMD
+   partitioner's all-reduce operands ARE the wire payload; same extraction
+   tests/test_comm_plan.py asserts on), and
+2. nominal v5e interconnect bandwidths from the public spec sheet
+   (ICI: 4 links x 400 Gbps/chip = 200 GB/s aggregate bidirectional;
+   DCN: 200 Gbps NIC per 8-chip host = 3.125 GB/s/chip), derated by an
+   achievable-fraction factor stated in the output.
+
+Model: ring all-reduce moves 2*(N-1)/N * P bytes through each chip's links;
+within one v5e pod slice (<=256 chips) the path is all-ICI. The projected
+efficiency is compute / (compute + exposed_comm) — conservative, because
+XLA's latency-hiding scheduler overlaps the gradient all-reduce with the
+backward pass (the overlap column assumes 70% of comm hides, the
+documented-typical case; 0% hiding is the floor column).
+
+Writes SCALING_r05.json and prints the doc/performance.md table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+ICI_GBS = 200.0        # v5e nominal: 4 ICI links x 400 Gbps, bidi aggregate
+DCN_GBS_PER_CHIP = 3.125  # 200 Gbps host NIC / 8 chips
+ACHIEVABLE = 0.7       # fraction of nominal a real collective sustains
+STEP_MS = 102.0        # measured b256 step, one chip (ROOFLINE_r03.json)
+OVERLAP = 0.7          # fraction of all-reduce hidden under backward
+
+
+def allreduce_bytes_from_hlo(n_dev=8):
+    """Compile the dp ResNet-50 train step over an n_dev virtual mesh and
+    sum the all-reduce payload bytes from the optimized HLO."""
+    os.environ.setdefault(
+        "XLA_FLAGS", f"--xla_force_host_platform_device_count={n_dev}")
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    import jax.numpy as jnp
+
+    from mxnet_tpu.executor import _build_graph_fn
+    from mxnet_tpu.models import resnet50
+    from mxnet_tpu.parallel import make_data_parallel_step, make_mesh
+
+    mesh = make_mesh(dp=n_dev, devices=jax.devices()[:n_dev])
+    sym = resnet50(num_classes=1000, layout="NHWC")
+    batch = 2 * n_dev
+    input_shapes = {"data": (batch, 224, 224, 3), "softmax_label": (batch,)}
+    arg_shapes, _, aux_shapes = sym.infer_shape(**input_shapes)
+    rng = np.random.RandomState(0)
+    params, pbytes = {}, 0
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in input_shapes:
+            continue
+        params[name] = jnp.asarray(
+            (rng.randn(*shape) * 0.05).astype(np.float32))
+        pbytes += int(np.prod(shape)) * 4
+    aux = {name: (jnp.ones(s, jnp.float32) if name.endswith("var")
+                  else jnp.zeros(s, jnp.float32))
+           for name, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    graph_fn = _build_graph_fn(sym, is_train=True)
+    zero_key = jnp.zeros((2,), jnp.uint32)
+
+    def loss_fn(p, b):
+        outs, _ = graph_fn({**p, **b, **aux}, aux, zero_key)
+        return sum(jnp.sum(o) for o in outs) / b["data"].shape[0]
+
+    def sgd(p, s, g):
+        return ({k: p[k] - 0.1 * g[k] for k in p}, s)
+
+    step = make_data_parallel_step(loss_fn, sgd, mesh, donate=False)
+    data = {"data": np.zeros((batch, 224, 224, 3), np.float32),
+            "softmax_label": np.zeros((batch,), np.float32)}
+    from mxnet_tpu.parallel import shard_batch
+
+    hlo = step.lower(params, {}, shard_batch(data, mesh)).compile().as_text()
+    total = 0
+    for line in hlo.splitlines():
+        m = re.search(r"=\s*(\([^)]*\)|\S+)\s+all-reduce(?:-start)?\(", line)
+        if not m:
+            continue
+        for elem, dims in re.findall(r"(f32|bf16|f16)\[([\d,]*)\]",
+                                     m.group(1)):
+            n = 1
+            for d in filter(None, dims.split(",")):
+                n *= int(d)
+            total += (4 if elem == "f32" else 2) * n
+    return total, pbytes
+
+
+def project(ar_bytes):
+    rows = []
+    for n in (8, 16, 32, 64, 128, 256):
+        wire = 2 * (n - 1) / n * ar_bytes
+        t_ici = wire / (ICI_GBS * ACHIEVABLE * 1e9) * 1e3      # ms
+        t_dcn = wire / (DCN_GBS_PER_CHIP * ACHIEVABLE * 1e9) * 1e3
+        eff_floor = STEP_MS / (STEP_MS + t_ici)
+        eff_overlap = STEP_MS / (STEP_MS + (1 - OVERLAP) * t_ici)
+        eff_dcn = STEP_MS / (STEP_MS + t_dcn)
+        rows.append({
+            "chips": n,
+            "allreduce_gb_per_chip": round(wire / 1e9, 4),
+            "t_comm_ici_ms": round(t_ici, 2),
+            "eff_ici_no_overlap": round(eff_floor, 4),
+            "eff_ici_70pct_overlap": round(eff_overlap, 4),
+            "eff_dcn_no_overlap": round(eff_dcn, 4),
+        })
+    return rows
+
+
+def main():
+    ar_bytes, pbytes = allreduce_bytes_from_hlo()
+    out = {
+        "model": "resnet50 dp train step (HLO-extracted collectives)",
+        "allreduce_payload_bytes_per_step": ar_bytes,
+        "param_bytes_f32": pbytes,
+        "assumptions": {
+            "step_ms_measured_1chip": STEP_MS,
+            "ici_gbs_nominal": ICI_GBS,
+            "dcn_gbs_per_chip_nominal": DCN_GBS_PER_CHIP,
+            "achievable_fraction": ACHIEVABLE,
+            "overlap_fraction": OVERLAP,
+            "note": "PROJECTION from compiled-HLO bytes + nominal public "
+                    "v5e bandwidths; not a multi-chip measurement (rig has "
+                    "one chip). Ring all-reduce 2(N-1)/N model.",
+        },
+        "projection": project(ar_bytes),
+    }
+    with open("SCALING_r05.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    # markdown table for doc/performance.md
+    print("\n| chips | all-reduce GB/chip | t_comm ICI (ms) | "
+          "eff (no overlap) | eff (70% overlap) | eff if DCN-bound |")
+    print("|---|---|---|---|---|---|")
+    for r in out["projection"]:
+        print(f"| {r['chips']} | {r['allreduce_gb_per_chip']:.3f} | "
+              f"{r['t_comm_ici_ms']:.2f} | {r['eff_ici_no_overlap']:.1%} | "
+              f"{r['eff_ici_70pct_overlap']:.1%} | "
+              f"{r['eff_dcn_no_overlap']:.1%} |")
+
+
+if __name__ == "__main__":
+    main()
