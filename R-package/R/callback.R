@@ -1,0 +1,26 @@
+# Training callbacks for the R binding (reference capability:
+# R-package/R/callback.R — mx.callback.log.train.metric and
+# mx.callback.save.checkpoint, invoked from the model.R train loop).
+#
+# Batch callbacks: function(env) with env$epoch, env$nbatch, env$metric
+# (accumulator state + get). Epoch callbacks: function(epoch, model).
+
+mx.callback.log.train.metric <- function(period = 50) {
+  function(env) {
+    if (env$nbatch %% period == 0) {
+      m <- env$metric.get(env$metric.state)
+      message(sprintf("Batch [%d] Train-%s=%f", env$nbatch,
+                      m$name, m$value))
+    }
+    TRUE
+  }
+}
+
+mx.callback.save.checkpoint <- function(prefix) {
+  function(epoch, model) {
+    mx.model.save(model, prefix, epoch)
+    message(sprintf("Model checkpoint saved to %s-%04d.params",
+                    prefix, epoch))
+    TRUE
+  }
+}

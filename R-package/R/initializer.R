@@ -1,0 +1,52 @@
+# Initializers for the R binding (reference capability:
+# R-package/R/initializer.R — mx.init.uniform / mx.init.normal /
+# mx.init.Xavier and the name-dispatch rules).
+#
+# An initializer is a function(name, shape) -> numeric vector of
+# prod(shape) values (row-major shape, as mx.symbol.infer.shapes returns).
+# Name dispatch matches the framework's Python layer
+# (mxnet_tpu/initializer.py): *weight -> the random rule, *bias/*beta ->
+# 0, *gamma -> 1, aux running-var -> 1, running-mean -> 0.
+
+mx.init.uniform <- function(scale) {
+  function(name, shape) runif(prod(shape), -scale, scale)
+}
+
+mx.init.normal <- function(sd) {
+  function(name, shape) rnorm(prod(shape)) * sd
+}
+
+# Glorot (mxnet_tpu/initializer.py:104-129): fan_out = shape[1] (leading
+# row-major dim), fan_in = prod of the rest.
+mx.init.Xavier <- function(rnd_type = "uniform", factor_type = "avg",
+                           magnitude = 3) {
+  function(name, shape) {
+    fan_out <- shape[1]
+    fan_in <- if (length(shape) > 1) prod(shape[-1]) else shape[1]
+    factor <- switch(factor_type,
+                     avg = (fan_in + fan_out) / 2,
+                     "in" = fan_in,
+                     out = fan_out,
+                     stop("bad factor_type ", factor_type))
+    scale <- sqrt(magnitude / factor)
+    if (rnd_type == "uniform") {
+      runif(prod(shape), -scale, scale)
+    } else if (rnd_type == "gaussian") {
+      rnorm(prod(shape)) * scale
+    } else {
+      stop("bad rnd_type ", rnd_type)
+    }
+  }
+}
+
+# full name-dispatch init for one argument/aux state
+mx.init.param <- function(initializer, name, shape) {
+  nel <- prod(shape)
+  if (grepl("gamma", name) || grepl("var$", name)) {
+    rep(1, nel)
+  } else if (grepl("weight", name)) {
+    initializer(name, shape)
+  } else {
+    rep(0, nel)  # bias/beta/running-mean and everything else
+  }
+}

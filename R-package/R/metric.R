@@ -1,0 +1,42 @@
+# Evaluation metrics for the R binding (reference capability:
+# R-package/R/metric.R — mx.metric.custom and the accuracy/rmse/mae set,
+# an environment-based accumulator with init/update/get).
+#
+# update(label, pred, state): label is the batch label vector, pred the
+# batch-by-classes (or batch-long) prediction matrix the executor
+# returned; state is the accumulator environment. feval returns the batch
+# MEAN; the accumulator weights it by the batch's sample count, so the
+# final partial (de-padded) batch counts sample-exactly, not batch-equal.
+
+mx.metric.custom <- function(name, feval) {
+  init <- function() {
+    env <- new.env()
+    env$sum <- 0
+    env$n <- 0
+    env
+  }
+  update <- function(label, pred, state) {
+    k <- length(label)
+    state$sum <- state$sum + feval(label, pred) * k
+    state$n <- state$n + k
+    state
+  }
+  get <- function(state) list(name = name, value = state$sum / state$n)
+  list(init = init, update = update, get = get)
+}
+
+mx.metric.accuracy <- mx.metric.custom("accuracy", function(label, pred) {
+  if (is.matrix(pred) && ncol(pred) > 1) {
+    mean((max.col(pred) - 1) == label)
+  } else {
+    mean((as.numeric(pred) > 0.5) == label)
+  }
+})
+
+mx.metric.rmse <- mx.metric.custom("rmse", function(label, pred) {
+  sqrt(mean((label - as.numeric(pred))^2))
+})
+
+mx.metric.mae <- mx.metric.custom("mae", function(label, pred) {
+  mean(abs(label - as.numeric(pred)))
+})

@@ -80,7 +80,16 @@ mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
                                         num.round = 10, learning.rate = 0.1,
                                         momentum = 0.9, wd = 0,
                                         initializer.scale = 0.1,
+                                        initializer = NULL,
+                                        eval.metric = NULL,
+                                        batch.end.callback = NULL,
+                                        epoch.end.callback = NULL,
                                         kv = NULL, verbose = TRUE) {
+  # default initializer keeps the historical behavior (normal * scale);
+  # pass e.g. mx.init.Xavier() for conv nets (initializer.R)
+  if (is.null(initializer))
+    initializer <- mx.init.normal(initializer.scale)
+  if (is.null(eval.metric)) eval.metric <- mx.metric.accuracy
   iter <- mx.io.NDArrayIter(X, y, batch.size = batch.size)
   nd <- length(dim(X))
   data_shape <- c(batch.size, rev(dim(X)[-nd]))
@@ -97,20 +106,12 @@ mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
   for (i in seq_along(arg_names)) {
     shp <- shapes$arg_shapes[[i]]
     nm <- arg_names[i]
-    nel <- prod(shp)
-    init <- if (grepl("weight", nm)) {
-      rnorm(nel) * initializer.scale
-    } else if (grepl("gamma", nm)) {
-      rep(1, nel)   # BatchNorm scale: zero would kill gradient flow
-    } else {
-      rep(0, nel)
-    }
-    args[i] <- .mxr.nd.from.host(shp, init)
+    args[i] <- .mxr.nd.from.host(shp, mx.init.param(initializer, nm, shp))
     if (nm == "data" || grepl("label", nm)) {
       grads[i] <- 0L
       reqs[i] <- 0L
     } else {
-      grads[i] <- .mxr.nd.from.host(shp, rep(0, nel))
+      grads[i] <- .mxr.nd.from.host(shp, rep(0, prod(shp)))
       reqs[i] <- 1L
       weight_ids[[length(weight_ids) + 1L]] <- args[i]
       grad_ids[[length(grad_ids) + 1L]] <- grads[i]
@@ -131,18 +132,26 @@ mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
   data_idx <- which(arg_names == "data")
   label_idx <- which(grepl("label", arg_names))
 
+  # with a kvstore the pulled gradient is the SUM across workers, so the
+  # rescale folds in num_workers — same semantics as the Python layer
+  # (mxnet_tpu/model.py fit: rescale_grad = 1/(batch_size*num_workers))
+  nworkers <- if (is.null(kv)) 1L else mx.kv.num.workers(kv)
   optimizer <- mx.opt.create("sgd", learning.rate = learning.rate,
                              momentum = momentum, wd = wd,
-                             rescale.grad = 1 / batch.size)
+                             rescale.grad = 1 / (batch.size * nworkers))
   updater <- mx.opt.get.updater(optimizer, weight_ids)
   if (!is.null(kv)) {
     mx.kv.init(kv, seq_along(weight_ids) - 1L, weight_ids)
   }
 
   acc <- 0
+  model <- structure(list(executor = ex, arg_names = arg_names, args = args,
+                          aux_names = aux_names, auxs = auxs,
+                          symbol = symbol, train_acc = 0),
+                     class = "mxtpu.model")
   for (round in seq_len(num.round)) {
-    correct <- 0
-    seen <- 0
+    mstate <- eval.metric$init()
+    nbatch <- 0
     iter$reset()
     while (iter$iter.next()) {
       b <- iter$value()
@@ -158,9 +167,9 @@ mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
       outs <- mx.executor.outputs(ex)
       prob <- as.array.mxtpu.ndarray(outs[[1]])  # batch x classes
       keep <- batch.size - b$pad
-      pred <- max.col(prob)[seq_len(keep)] - 1
-      correct <- correct + sum(pred == b$label[seq_len(keep)])
-      seen <- seen + keep
+      mstate <- eval.metric$update(
+        b$label[seq_len(keep)], prob[seq_len(keep), , drop = FALSE], mstate)
+      nbatch <- nbatch + 1
       for (o in outs) mx.nd.free(o)
       mx.executor.backward(ex)
       if (!is.null(kv)) {
@@ -170,14 +179,18 @@ mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
         mx.kv.pull(kv, seq_along(grad_ids) - 1L, grad_ids)
       }
       updater(weight_ids, grad_ids)
+      if (!is.null(batch.end.callback)) {
+        batch.end.callback(list(epoch = round, nbatch = nbatch,
+                                metric.state = mstate,
+                                metric.get = eval.metric$get))
+      }
     }
+    m <- eval.metric$get(mstate)
     if (verbose)
-      message(sprintf("Round [%d] train accuracy: %.4f", round,
-                      correct / seen))
-    acc <- correct / seen
+      message(sprintf("Round [%d] train %s: %.4f", round, m$name, m$value))
+    acc <- m$value
+    model$train_acc <- acc
+    if (!is.null(epoch.end.callback)) epoch.end.callback(round, model)
   }
-  structure(list(executor = ex, arg_names = arg_names, args = args,
-                 aux_names = aux_names, auxs = auxs,
-                 symbol = symbol, train_acc = acc),
-            class = "mxtpu.model")
+  model
 }

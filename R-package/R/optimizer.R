@@ -90,8 +90,9 @@ mx.nd.zeros.like <- function(h) {
   r <- .mxr.status(.C("mxr_nd_create", as.integer(shp),
                       as.integer(length(shp)), id = integer(1),
                       status = integer(1)))
-  .mxr.status(.C("mxr_nd_set", as.integer(r$id), as.double(rep(0, prod(shp))),
-                 as.integer(prod(shp)), status = integer(1)))
+  # runtime-side fill (_set_value) — no prod(shape) host doubles crossing
+  # the .C boundary just to zero device memory
+  .mxr.func("_set_value", integer(0), 0, r$id)
   structure(r$id, class = "mxtpu.ndarray", dims = rev(shp))
 }
 

@@ -12,7 +12,11 @@
 
 dyn.load(file.path("src", "libmxtpu_r_train.so"))
 source(file.path("R", "mxtpu_train.R"))
+source(file.path("R", "mxtpu_generated.R"))
 source(file.path("R", "optimizer.R"))
+source(file.path("R", "initializer.R"))
+source(file.path("R", "metric.R"))
+source(file.path("R", "callback.R"))
 source(file.path("R", "io.R"))
 source(file.path("R", "kvstore.R"))
 source(file.path("R", "model.R"))
@@ -54,7 +58,11 @@ cat("arguments:", paste(mx.symbol.arguments(net), collapse = ", "), "\n")
 kv <- mx.kv.create("local")
 model <- mx.model.FeedForward.create(net, X, y, batch.size = 32,
                                      num.round = 8, learning.rate = 0.1,
-                                     momentum = 0.9, kv = kv)
+                                     momentum = 0.9, kv = kv,
+                                     initializer = mx.init.Xavier(),
+                                     eval.metric = mx.metric.accuracy,
+                                     batch.end.callback =
+                                       mx.callback.log.train.metric(8))
 
 stopifnot(model$train_acc > 0.9)
 

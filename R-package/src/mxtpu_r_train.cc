@@ -272,6 +272,7 @@ void mxr_nd_load(char** fname, int* cap, int* n_out, int* ids_out,
   *status = record(MXNDArrayLoad(fname[0], &n, &hs, &n_names, &names));
   if (*status != 0) return;
   if ((int)n > *cap || n_names != n) {
+    for (mx_uint i = 0; i < n; ++i) MXNDArrayFree(hs[i]);
     g_last_error = "mxr_nd_load: more arrays than caller capacity (or "
                    "unnamed entries; R checkpoints are always named)";
     *status = -1;

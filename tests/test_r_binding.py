@@ -511,6 +511,10 @@ def test_r_shim_func_invoke_optimizer_math(train_shim):
     np.testing.assert_allclose(nd_get(hw, 6), w, atol=1e-5)
     np.testing.assert_allclose(nd_get(hmom, 6), mom, atol=1e-5)
 
+    # _set_value with no use-vars: optimizer.R's mx.nd.zeros.like fill
+    func("_set_value", [], [0.0], hscratch)
+    np.testing.assert_allclose(nd_get(hscratch, 6), np.zeros(6), atol=0)
+
 
 def test_r_shim_kvstore(train_shim):
     """mx.kv.* surface: init/push/pull aggregation on a local store plus

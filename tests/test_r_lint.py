@@ -102,3 +102,17 @@ def test_r_cross_file_function_references():
         missing = {c for c in calls if c not in defined}
         assert not missing, (
             f"{path} calls undefined package functions: {sorted(missing)}")
+
+
+def test_r_generated_current():
+    """R-package/R/mxtpu_generated.R must match a fresh regeneration (the
+    same regen-exact guard tools/gen_op_docs.py has for the op docs)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "gen_r_ops.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, (r.stdout + r.stderr)[-1500:]

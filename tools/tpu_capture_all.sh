@@ -1,0 +1,32 @@
+#!/bin/bash
+# One-window TPU evidence capture (round 5). The axon tunnel historically
+# wedges without warning (BENCH_NOTES_r03.md §6), so when a healthy window
+# opens, capture EVERYTHING in one pass, cheapest-first, warming the
+# persistent compile cache (/tmp/mxtpu_jax_cache) as it goes:
+#   1. bench.py --steps 20      headline capture (also warms the cache so
+#                               the driver's end-of-round run is compile-free)
+#   2. bench.py re-run          warm-cache verification (target <= 2 min)
+#   3. bench_roofline.py        per-op HBM bytes table + measured floors
+#   4. bench.py --mode io       io-fed overlap measurement
+# Every stage appends to TPU_CAPTURE_r05.log; JSON artifacts land at the
+# repo root. Stages run independently: a late-wedge kills at most the tail.
+set -u
+cd "$(dirname "$0")/.."
+LOG=TPU_CAPTURE_r05.log
+echo "=== capture start $(date -u +%FT%TZ)" | tee -a "$LOG"
+
+run_stage() {
+  local name="$1"; shift
+  echo "--- $name: $* ($(date -u +%T))" | tee -a "$LOG"
+  local t0=$SECONDS
+  timeout 2000 "$@" >> "$LOG" 2>&1
+  local rc=$?
+  echo "--- $name done rc=$rc in $((SECONDS-t0))s" | tee -a "$LOG"
+  return $rc
+}
+
+run_stage bench_cold python bench.py --steps 20 || exit 1
+run_stage bench_warm python bench.py --steps 20
+run_stage roofline python tools/bench_roofline.py --out ROOFLINE_r05.json
+run_stage io_bench python bench.py --mode io --epochs 3
+echo "=== capture end $(date -u +%FT%TZ)" | tee -a "$LOG"
