@@ -19,28 +19,13 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
-import numpy as np
-
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 
 import mxnet_tpu as mx
+from lenet_dist_common import make_dataset
 from mxnet_tpu.models import lenet
-
-
-def make_dataset(n=512, seed=42):
-    """Deterministic 4-class 28x28 images (bright square per quadrant),
-    identical on every worker — the multi-node discipline of the
-    reference's common.py (fixed seed, no iterator randomness)."""
-    rng = np.random.RandomState(seed)
-    X = rng.randn(n, 1, 28, 28).astype(np.float32) * 0.1
-    y = rng.randint(0, 4, (n,)).astype(np.float32)
-    corners = {0: (2, 2), 1: (2, 16), 2: (16, 2), 3: (16, 16)}
-    for i in range(n):
-        r, c = corners[int(y[i])]
-        X[i, 0, r:r + 10, c:c + 10] += 1.0
-    return X, y
 
 
 def main():

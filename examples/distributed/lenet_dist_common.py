@@ -1,0 +1,19 @@
+"""Shared dataset for the multi-node LeNet tiers (reference:
+tests/python/multi-node/common.py — one deterministic dataset module the
+sync and async conv-net scripts both import, randomness fixed so every
+worker and every run sees identical data)."""
+
+import numpy as np
+
+
+def make_dataset(n=512, seed=42):
+    """Deterministic 4-class 28x28 images: a bright square in one of the
+    four quadrants identifies the class."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 1, 28, 28).astype(np.float32) * 0.1
+    y = rng.randint(0, 4, (n,)).astype(np.float32)
+    corners = {0: (2, 2), 1: (2, 16), 2: (16, 2), 3: (16, 16)}
+    for i in range(n):
+        r, c = corners[int(y[i])]
+        X[i, 0, r:r + 10, c:c + 10] += 1.0
+    return X, y

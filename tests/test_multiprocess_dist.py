@@ -126,6 +126,24 @@ def test_dist_async_staleness_4proc():
 
 
 @pytest.mark.slow
+def test_dist_async_lenet_2proc():
+    """Async-PS CONV-NET tier (reference: multi-node/dist_async_lenet.py):
+    conv gradients to the update-on-arrival parameter host, accuracy
+    asserted on both workers."""
+    script = os.path.join(REPO, "examples", "distributed",
+                          "dist_async_lenet.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", sys.executable, script],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert res.stdout.count("dist_async_lenet accuracy") == 2, \
+        res.stdout + res.stderr[-2000:]
+
+
+@pytest.mark.slow
 def test_dist_async_mlp_2proc():
     """End-to-end async-PS training across 2 real processes: optimizer on
     the parameter host, per-batch push/pull, no collectives (reference:

@@ -8,6 +8,9 @@
 #   2. bench.py re-run          warm-cache verification (target <= 2 min)
 #   3. bench_roofline.py        per-op HBM bytes table + measured floors
 #   4. bench.py --mode io       io-fed overlap measurement
+#   5. bench.py --model inception_bn   same-architecture baseline number
+#      (LAST: its compile is guaranteed-cold, so a late wedge there costs
+#      nothing already captured)
 # Every stage appends to TPU_CAPTURE_r05.log; JSON artifacts land at the
 # repo root. Stages run independently: a late-wedge kills at most the tail.
 set -u
@@ -29,4 +32,5 @@ run_stage bench_cold python bench.py --steps 20 || exit 1
 run_stage bench_warm python bench.py --steps 20
 run_stage roofline python tools/bench_roofline.py --out ROOFLINE_r05.json
 run_stage io_bench python bench.py --mode io --epochs 3
+run_stage inception python bench.py --model inception_bn --steps 20
 echo "=== capture end $(date -u +%FT%TZ)" | tee -a "$LOG"
