@@ -26,12 +26,17 @@ def make_synthetic_rec(path, n=512, num_classes=100, size=256, seed=0):
     from mxnet_tpu import recordio as rio
 
     rng = np.random.RandomState(seed)
-    w = rio.MXRecordIO(path, "w")
+    # write-then-rename: under tools/launch.py several workers race to
+    # create the same shard; os.replace makes the publish atomic so a
+    # reader never sees a half-written file
+    tmp = f"{path}.w{os.getpid()}"
+    w = rio.MXRecordIO(tmp, "w")
     for i in range(n):
         img = rng.randint(0, 255, (size, size, 3), np.uint8)
         w.write(rio.pack_img(rio.IRHeader(0, float(i % num_classes), i, 0),
                              img, img_fmt=".jpg", quality=85))
     w.close()
+    os.replace(tmp, path)
     return path
 
 
@@ -66,18 +71,43 @@ def main():
     import mxnet_tpu as mx
 
     logging.basicConfig(level=logging.INFO)
+    # dist kvstore first: the iterator shards by worker rank (reference:
+    # ImageRecordIter num_parts/part_index from kvstore rank, so each
+    # worker reads only its slice — multi-node/README.md discipline)
+    kv = mx.kv.create(args.kv_store) if "dist" in args.kv_store \
+        else args.kv_store
+    num_parts, part_index = (kv.num_workers, kv.rank) \
+        if "dist" in args.kv_store else (1, 0)
+
     rec = args.data_rec
     if rec is None:
         args.num_classes = 100
-        rec = os.path.join(tempfile.gettempdir(), "imagenet_synth.rec")
+        n_synth = int(os.environ.get("MXTPU_SYNTH_IMAGES", "512"))
+        # filename keyed on n: a cached shard from a different size must
+        # not be silently reused
+        rec = os.path.join(tempfile.gettempdir(),
+                           f"imagenet_synth_{n_synth}.rec")
         if not os.path.exists(rec):
-            logging.info("generating synthetic ImageNet rec at %s", rec)
-            make_synthetic_rec(rec)
+            if part_index == 0:
+                # rank 0 generates, the rest wait for the atomic publish
+                # (same discipline as the iterator's cached mean image,
+                # io/__init__.py) — N identical JPEG passes are wasted CPU
+                logging.info("generating synthetic ImageNet rec at %s", rec)
+                make_synthetic_rec(rec, n=n_synth)
+            else:
+                import time as _time
+
+                deadline = _time.time() + 600
+                while not os.path.exists(rec):
+                    if _time.time() > deadline:
+                        raise RuntimeError(f"timed out waiting for {rec}")
+                    _time.sleep(0.5)
 
     train = mx.io.ImageRecordIter(
         path_imgrec=rec, data_shape=(3, 224, 224), batch_size=args.batch_size,
         rand_crop=True, rand_mirror=True, shuffle=True, resize=256,
-        mean_r=123.68, mean_g=116.78, mean_b=103.94, scale=1 / 58.8)
+        mean_r=123.68, mean_g=116.78, mean_b=103.94, scale=1 / 58.8,
+        num_parts=num_parts, part_index=part_index)
 
     net = NETWORKS[args.network](args.num_classes)
     ctx = [mx.tpu(i) for i in range(args.num_devices)]
@@ -87,10 +117,14 @@ def main():
                                    magnitude=2),
         compute_dtype=jnp.bfloat16 if args.bf16 else None,
         lr=args.lr, momentum=0.9, wd=1e-4)
-    model.fit(train, kvstore=args.kv_store,
+    # checkpoint from rank 0 only: every rank holds the same BSP-synced
+    # weights, and two ranks writing one prefix would race/truncate
+    callbacks = mx.callback.do_checkpoint(
+        os.path.join(tempfile.gettempdir(), args.network)) \
+        if part_index == 0 else None
+    model.fit(train, kvstore=kv,
               batch_end_callback=mx.callback.Speedometer(args.batch_size, 10),
-              epoch_end_callback=mx.callback.do_checkpoint(
-                  os.path.join(tempfile.gettempdir(), args.network)))
+              epoch_end_callback=callbacks)
 
 
 if __name__ == "__main__":
