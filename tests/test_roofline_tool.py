@@ -1,0 +1,70 @@
+"""Unit tier for tools/bench_roofline.py's per-op HBM accounting: the
+parser must charge ENTRY instructions operand+output bytes, skip
+zero-traffic opcodes, and — critically — NOT charge fusion-body
+instructions (they never touch HBM; counting them was the round-5 review's
+top finding). Driven with a hand-written HLO module so no compile is
+needed; the same code path runs on the real compiled step on TPU."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bench_roofline import _shape_nbytes, per_op_bytes_table  # noqa: E402
+
+HLO = """\
+HloModule jit_step, entry_computation_layout={(f32[8,4]{1,0})->f32[8,4]{1,0}}
+
+%fused_computation.1 (p0: f32[8,4]) -> f32[8,4] {
+  %p0 = f32[8,4]{1,0} parameter(0)
+  %big_internal = f32[8,4]{1,0} add(f32[8,4]{1,0} %p0, f32[8,4]{1,0} %p0)
+  ROOT %m = f32[8,4]{1,0} multiply(f32[8,4]{1,0} %big_internal, f32[8,4]{1,0} %p0)
+}
+
+ENTRY %main (a: f32[8,4]) -> f32[8,4] {
+  %a = f32[8,4]{1,0} parameter(0)
+  %c = f32[] constant(1)
+  %mul = f32[8,4]{1,0} multiply(f32[8,4]{1,0} %a, f32[8,4]{1,0} %a)
+  %b = bf16[8,4]{1,0} convert(f32[8,4]{1,0} %a)
+  %fus = f32[8,4]{1,0} fusion(f32[8,4]{1,0} %a), kind=kLoop, calls=%fused_computation.1, metadata={op_name="jit(step)/mul" source_file="x.py"}
+  %tup = (f32[8,4]{1,0}, bf16[8,4]{1,0}) tuple(f32[8,4]{1,0} %fus, bf16[8,4]{1,0} %b)
+  ROOT %out = f32[8,4]{1,0} get-tuple-element((f32[8,4]{1,0}, bf16[8,4]{1,0}) %tup), index=0
+}
+"""
+
+
+class FakeCompiled:
+    def as_text(self):
+        return HLO
+
+
+def test_shape_nbytes():
+    assert _shape_nbytes("f32[8,4]") == 128
+    assert _shape_nbytes("bf16[8,4]{1,0}") == 64
+    assert _shape_nbytes("pred[16]") == 16
+    assert _shape_nbytes("f32[]") == 4  # scalar: empty dims -> 1 elem
+    assert _shape_nbytes("nonsense") == 0
+
+
+def test_per_op_table_entry_only_and_operand_accounting():
+    rows, totals = per_op_bytes_table(FakeCompiled())
+    by_name = {r["name"]: r for r in rows}
+
+    # fusion-body instructions excluded (big_internal/m never touch HBM)
+    assert "big_internal" not in by_name and "m" not in by_name
+    # parameter/constant/tuple/gte carry no rows of their own
+    for skipped in ("a", "c", "tup", "out"):
+        assert skipped not in by_name
+    # convert: reads f32[8,4] (128 B) + writes bf16[8,4] (64 B)
+    assert abs(by_name["b"]["gbytes"] * 1e9 - (128 + 64)) < 1
+    # fusion: reads %a (128) + writes f32[8,4] (128) — and NOT inflated by
+    # the metadata op_name path "jit(step)/mul" colliding with the ENTRY
+    # instruction named "mul" (phantom-operand guard)
+    assert abs(by_name["fus"]["gbytes"] * 1e9 - 256) < 1
+    # mul itself: two reads of %a + one write = 3 * 128
+    assert abs(by_name["mul"]["gbytes"] * 1e9 - 384) < 1
+    # metadata source attribution captured
+    assert by_name["fus"]["source"] == "jit(step)/mul"
+    # opcode totals cover exactly the charged instructions
+    assert set(totals) == {"convert", "fusion", "multiply"}

@@ -129,7 +129,7 @@ _SHAPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
 def _shape_nbytes(shape_str):
     """Bytes of one HLO shape token like 'bf16[256,56,56,64]{3,2,1,0}'
     (layout suffix ignored; tuples handled by the caller)."""
-    m = re.match(r"([a-z]\d*|pred)\[([\d,]*)\]", shape_str)
+    m = re.match(r"([a-z]+\d*)\[([\d,]*)\]", shape_str)
     if not m:
         return 0
     elem = _SHAPE_BYTES.get(m.group(1), 4)
@@ -173,7 +173,7 @@ def per_op_bytes_table(compiled, top_k=25):
     # name -> output nbytes (tuple shapes: sum of leaves)
     out_bytes = {}
     inst_re = re.compile(
-        r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(\([^)]*\)|[a-z]\d*\[[^\]]*\]"
+        r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(\([^)]*\)|[a-z]+\d*\[[^\]]*\]"
         r"(?:\{[^}]*\})?)\s+([\w\-]+)\(")
     insts = []
     for line in entry_lines:
@@ -183,7 +183,7 @@ def per_op_bytes_table(compiled, top_k=25):
         name, shape_s, opcode = m.groups()
         if shape_s.startswith("("):
             nbytes = sum(_shape_nbytes(s) for s in
-                         re.findall(r"[a-z]\d*\[[\d,]*\]", shape_s))
+                         re.findall(r"[a-z]+\d*\[[\d,]*\]", shape_s))
         else:
             nbytes = _shape_nbytes(shape_s)
         out_bytes[name] = nbytes
@@ -200,11 +200,26 @@ def per_op_bytes_table(compiled, top_k=25):
         if opcode in skip:
             continue
         body = line.split("(", 1)[1]
+        # operands live in the argument list only: cut the attribute tail
+        # (kind=/calls=/metadata=/...) so e.g. an op_name path containing
+        # "add" cannot be charged as a phantom operand of this instruction
+        for marker in (", kind=", ", calls=", ", metadata=", ", sharding=",
+                       ", to_apply=", ", backend_config=",
+                       ", control-predecessors=", ", dimensions=",
+                       ", custom_call_target="):
+            idx = body.find(marker)
+            if idx != -1:
+                body = body[:idx]
         ops = [t for t in re.findall(r"%?([\w.\-]+)", body)
                if t in out_bytes]
         total = nbytes + sum(out_bytes[o] for o in ops)
+        # source attribution: XLA metadata carries the jax op_name path
+        # (e.g. ".../bn4c/batch_norm"), which maps the fusion back to the
+        # model layer that produced it
+        meta = re.search(r'op_name="([^"]*)"', line)
         rows.append({"name": name, "opcode": opcode,
                      "gbytes": total / 1e9,
+                     "source": (meta.group(1)[-80:] if meta else None),
                      "shape": shape_s if len(shape_s) < 64 else
                      shape_s[:61] + "..."})
     rows.sort(key=lambda r: -r["gbytes"])
@@ -275,7 +290,9 @@ def main():
     top_rows, op_totals = per_op_bytes_table(compiled)
     print("top HBM-traffic instructions (operand+output bytes):")
     for r in top_rows[:15]:
-        print(f"  {r['gbytes']:7.3f} GB  {r['opcode']:<22} {r['name']}")
+        src = f"  <- {r['source']}" if r.get("source") else ""
+        print(f"  {r['gbytes']:7.3f} GB  {r['opcode']:<22} "
+              f"{r['name']}{src}")
     print("traffic by opcode:",
           {k: round(v, 2) for k, v in list(op_totals.items())[:8]})
 
