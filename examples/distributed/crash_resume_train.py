@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Failure recovery demonstration (SURVEY.md §5: the reference has no
+elastic recovery — its story, and TPU practice, is checkpoint/restore +
+re-launch; reference anchors: the kStopServer teardown in kvstore_dist.h
+and callback.do_checkpoint).
+
+Run once with MXTPU_CRASH_AFTER_EPOCH=2: the process hard-dies (os._exit,
+no cleanup — simulating a preemption/OOM kill) right after epoch 2's
+sharded checkpoint lands. Run again without it: fit() auto-resumes from
+the newest complete step in the checkpoint dir and trains to completion.
+
+    MXTPU_CRASH_AFTER_EPOCH=2 python crash_resume_train.py /tmp/ckpt || true
+    python crash_resume_train.py /tmp/ckpt     # resumes at epoch 2
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import mlp
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    crash_after = int(os.environ.get("MXTPU_CRASH_AFTER_EPOCH", "0"))
+
+    rng = np.random.RandomState(0)
+    X = np.concatenate([rng.randn(128, 8) + 1.0,
+                        rng.randn(128, 8) - 1.0]).astype(np.float32)
+    y = np.concatenate([np.ones(128), np.zeros(128)]).astype(np.float32)
+
+    def maybe_crash(epoch, symbol, arg_params, aux_params):
+        if crash_after and epoch + 1 >= crash_after:
+            print(f"simulated preemption after epoch {epoch}", flush=True)
+            os._exit(137)  # hard kill: no atexit, no flush, like the real thing
+
+    model = mx.FeedForward(mlp(num_classes=2, hidden=(16,)), num_epoch=5,
+                           optimizer="sgd", learning_rate=0.1,
+                           initializer=mx.init.Xavier())
+    model.fit(X, y, batch_size=32, sharded_checkpoint_dir=ckpt_dir,
+              epoch_end_callback=maybe_crash)
+
+    acc = model.score(X, y=y)
+    print(f"crash_resume final accuracy = {acc:.4f} "
+          f"(resumed from epoch {model.begin_epoch})")
+    assert acc > 0.95, acc
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,8 @@ ENTRY %main (a: f32[8,4]) -> f32[8,4] {
   %a = f32[8,4]{1,0} parameter(0)
   %c = f32[] constant(1)
   %mul = f32[8,4]{1,0} multiply(f32[8,4]{1,0} %a, f32[8,4]{1,0} %a)
+  %pad = f32[8,4]{1,0} pad(f32[8,4]{1,0} %a, f32[] %c), padding=0_0x0_0
+  %conv = f32[8,4]{1,0} convolution(f32[8,4]{1,0} %a, f32[8,4]{1,0} %a), window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f
   %b = bf16[8,4]{1,0} convert(f32[8,4]{1,0} %a)
   %fus = f32[8,4]{1,0} fusion(f32[8,4]{1,0} %a), kind=kLoop, calls=%fused_computation.1, metadata={op_name="jit(step)/mul" source_file="x.py"}
   %tup = (f32[8,4]{1,0}, bf16[8,4]{1,0}) tuple(f32[8,4]{1,0} %fus, bf16[8,4]{1,0} %b)
@@ -64,7 +66,14 @@ def test_per_op_table_entry_only_and_operand_accounting():
     assert abs(by_name["fus"]["gbytes"] * 1e9 - 256) < 1
     # mul itself: two reads of %a + one write = 3 * 128
     assert abs(by_name["mul"]["gbytes"] * 1e9 - 384) < 1
+    # conv: the attribute tail (window={... pad=...}, dim_labels=...)
+    # contains the token "pad", which IS an ENTRY instruction name — the
+    # balanced-paren cut must keep it out of conv's operand charge
+    assert abs(by_name["conv"]["gbytes"] * 1e9 - 384) < 1
+    # pad: reads %a (128) + scalar %c (4) + writes 128
+    assert abs(by_name["pad"]["gbytes"] * 1e9 - 260) < 1
     # metadata source attribution captured
     assert by_name["fus"]["source"] == "jit(step)/mul"
     # opcode totals cover exactly the charged instructions
-    assert set(totals) == {"convert", "fusion", "multiply"}
+    assert set(totals) == {"convert", "fusion", "multiply", "convolution",
+                           "pad"}

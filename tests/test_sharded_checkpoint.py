@@ -2,6 +2,8 @@
 checkpoint tier 4 — trainer save/resume — rebuilt as Orbax-style sharded
 pytree checkpoints that restore onto arbitrary mesh layouts)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -65,6 +67,34 @@ def test_multiple_steps_and_latest(tmp_path):
     p5, _, _, _, _ = load_sharded(tmp_path, step=5)
     np.testing.assert_allclose(p5["fc1_bias"],
                                np.asarray(params["fc1_bias"]))
+
+
+def test_crash_and_relaunch_resumes(tmp_path):
+    """The recovery story end-to-end (SURVEY §5: checkpoint/restore +
+    re-launch IS the failure-recovery design, matching TPU practice): a
+    training process hard-killed mid-run (os._exit, no cleanup) is
+    relaunched and auto-resumes from the newest complete sharded step."""
+    import subprocess
+    import sys as _sys
+
+    script = os.path.join(os.path.dirname(__file__), "..", "examples",
+                          "distributed", "crash_resume_train.py")
+    d = str(tmp_path / "ckpt")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXTPU_CRASH_AFTER_EPOCH="2")
+    env.pop("XLA_FLAGS", None)
+    r1 = subprocess.run([_sys.executable, script, d], env=env,
+                        capture_output=True, text=True, timeout=300)
+    assert r1.returncode == 137, (r1.returncode, r1.stderr[-1000:])
+    assert "simulated preemption" in r1.stdout
+    assert latest_step(d) == 2  # epoch 2's checkpoint survived the kill
+
+    env.pop("MXTPU_CRASH_AFTER_EPOCH")
+    r2 = subprocess.run([_sys.executable, script, d], env=env,
+                        capture_output=True, text=True, timeout=300)
+    assert r2.returncode == 0, (r2.stdout + r2.stderr)[-1500:]
+    assert "resumed from epoch 2" in r2.stdout, r2.stdout
+    assert latest_step(d) == 5
 
 
 def test_fit_sharded_checkpoint_and_resume(tmp_path):

@@ -154,7 +154,9 @@ def per_op_bytes_table(compiled, top_k=25):
     its outputs).
 
     Returns (rows, totals_by_opcode): rows = [{name, opcode, gbytes,
-    shape}], both sorted desc."""
+    source, shape}] sorted desc — ``source`` is the XLA metadata op_name
+    path (model-layer attribution; None when absent, tail-truncated to 80
+    chars)."""
     hlo = compiled.as_text()
     # ENTRY computation only: fusion bodies (%fused_computation.N { ... })
     # list their internal elementwise ops with the same line shape, but
@@ -200,16 +202,20 @@ def per_op_bytes_table(compiled, top_k=25):
         if opcode in skip:
             continue
         body = line.split("(", 1)[1]
-        # operands live in the argument list only: cut the attribute tail
-        # (kind=/calls=/metadata=/...) so e.g. an op_name path containing
-        # "add" cannot be charged as a phantom operand of this instruction
-        for marker in (", kind=", ", calls=", ", metadata=", ", sharding=",
-                       ", to_apply=", ", backend_config=",
-                       ", control-predecessors=", ", dimensions=",
-                       ", custom_call_target="):
-            idx = body.find(marker)
-            if idx != -1:
-                body = body[:idx]
+        # operands live in the argument list only: cut at the call's
+        # balanced closing paren (structural, not a marker list) so tokens
+        # in attribute tails — metadata op_name paths, window=, dim_labels=
+        # — can never be charged as phantom operands of this instruction.
+        # Tuple-typed operands nest parens; track depth.
+        depth = 0
+        for i, ch in enumerate(body):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                if depth == 0:
+                    body = body[:i]
+                    break
+                depth -= 1
         ops = [t for t in re.findall(r"%?([\w.\-]+)", body)
                if t in out_bytes]
         total = nbytes + sum(out_bytes[o] for o in ops)
