@@ -30,6 +30,7 @@ ENTRY %main (a: f32[8,4]) -> f32[8,4] {
   %conv = f32[8,4]{1,0} convolution(f32[8,4]{1,0} %a, f32[8,4]{1,0} %a), window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f
   %b = bf16[8,4]{1,0} convert(f32[8,4]{1,0} %a)
   %fus = f32[8,4]{1,0} fusion(f32[8,4]{1,0} %a), kind=kLoop, calls=%fused_computation.1, metadata={op_name="jit(step)/mul" source_file="x.py"}
+  %fus2 = (f32[8,4]{1,0}, f32[8,4]{1,0}) fusion(f32[8,4]{1,0} %a, f32[8,4]{1,0} %mul), kind=kLoop, calls=%fused_computation.1
   %tup = (f32[8,4]{1,0}, bf16[8,4]{1,0}) tuple(f32[8,4]{1,0} %fus, bf16[8,4]{1,0} %b)
   ROOT %out = f32[8,4]{1,0} get-tuple-element((f32[8,4]{1,0}, bf16[8,4]{1,0}) %tup), index=0
 }
@@ -72,6 +73,11 @@ def test_per_op_table_entry_only_and_operand_accounting():
     assert abs(by_name["conv"]["gbytes"] * 1e9 - 384) < 1
     # pad: reads %a (128) + scalar %c (4) + writes 128
     assert abs(by_name["pad"]["gbytes"] * 1e9 - 260) < 1
+    # MULTI-OUTPUT fusion: the operand scan must anchor at the CALL paren,
+    # not the line's first '(' (which opens the output-shape tuple) — a
+    # first-paren anchor would drop both operand reads entirely.
+    # writes 2x128 (tuple leaves) + reads %a (128) + %mul (128)
+    assert abs(by_name["fus2"]["gbytes"] * 1e9 - 512) < 1
     # metadata source attribution captured
     assert by_name["fus"]["source"] == "jit(step)/mul"
     # opcode totals cover exactly the charged instructions

@@ -189,7 +189,10 @@ def per_op_bytes_table(compiled, top_k=25):
         else:
             nbytes = _shape_nbytes(shape_s)
         out_bytes[name] = nbytes
-        insts.append((name, opcode, nbytes, shape_s, line))
+        # m.end() sits just past the CALL's opening paren (inst_re ends
+        # with \() — the only safe operand-scan anchor: tuple OUTPUT
+        # shapes put earlier parens on the line
+        insts.append((name, opcode, nbytes, shape_s, line, m.end()))
     # charge operands: tokens inside the call parens that name an ENTRY
     # instruction (sigil-robust: newer XLA dumps omit the % prefix — the
     # out_bytes membership test is what identifies operand references).
@@ -198,10 +201,10 @@ def per_op_bytes_table(compiled, top_k=25):
     skip = {"parameter", "constant", "get-tuple-element", "tuple",
             "bitcast"}
     rows = []
-    for name, opcode, nbytes, shape_s, line in insts:
+    for name, opcode, nbytes, shape_s, line, body_start in insts:
         if opcode in skip:
             continue
-        body = line.split("(", 1)[1]
+        body = line[body_start:]
         # operands live in the argument list only: cut at the call's
         # balanced closing paren (structural, not a marker list) so tokens
         # in attribute tails — metadata op_name paths, window=, dim_labels=
