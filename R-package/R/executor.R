@@ -1,0 +1,33 @@
+# Executor layer of the R binding (reference capability:
+# R-package/R/executor.R). Split out of mxtpu_train.R to mirror the
+# reference's module layout; all files source() into one namespace —
+# see demo/lenet_train.R for the canonical load order.
+
+# ----------------------------------------------------------------- Executor
+
+mx.executor.bind <- function(sym, arg_ids, grad_ids, reqs, aux_ids) {
+  r <- .mxr.status(.C("mxr_exec_bind", as.integer(sym),
+                      as.integer(length(arg_ids)), as.integer(arg_ids),
+                      as.integer(grad_ids), as.integer(reqs),
+                      as.integer(length(aux_ids)), as.integer(aux_ids),
+                      id = integer(1), status = integer(1)))
+  structure(r$id, class = "mxtpu.executor")
+}
+
+mx.executor.forward <- function(ex, is.train = FALSE) {
+  invisible(.mxr.status(.C("mxr_exec_forward", as.integer(ex),
+                           as.integer(is.train), status = integer(1))))
+}
+
+mx.executor.backward <- function(ex) {
+  invisible(.mxr.status(.C("mxr_exec_backward", as.integer(ex),
+                           status = integer(1))))
+}
+
+mx.executor.outputs <- function(ex) {
+  r <- .mxr.status(.C("mxr_exec_outputs", as.integer(ex),
+                      ids = integer(64), n = integer(1),
+                      status = integer(1)))
+  lapply(seq_len(r$n), function(i)
+    structure(r$ids[i], class = "mxtpu.ndarray"))
+}

@@ -22,165 +22,12 @@ mx.r.seed <- function(seed) {
                            status = integer(1))))
 }
 
-# ------------------------------------------------------------------ NDArray
-
-mx.nd.array <- function(data) {
-  # R arrays are column-major; the runtime is row-major. aperm the data,
-  # keep the LOGICAL dims (same convention as mxtpu.R's predictor layer).
-  dims <- dim(data)
-  if (is.null(dims)) dims <- length(data)
-  r <- .mxr.status(.C("mxr_nd_create", as.integer(dims),
-                      as.integer(length(dims)), id = integer(1),
-                      status = integer(1)))
-  h <- structure(r$id, class = "mxtpu.ndarray", dims = dims)
-  rowmajor <- aperm(array(data, dims), rev(seq_along(dims)))
-  .mxr.status(.C("mxr_nd_set", as.integer(h), as.double(rowmajor),
-                 as.integer(length(rowmajor)), status = integer(1)))
-  h
-}
-
-mx.nd.zeros <- function(shape) mx.nd.array(array(0, dim = shape))
-
-mx.nd.shape <- function(h) {
-  r <- .mxr.status(.C("mxr_nd_shape", as.integer(h), ndim = integer(1),
-                      shape = integer(8), status = integer(1)))
-  r$shape[seq_len(r$ndim)]
-}
-
-as.array.mxtpu.ndarray <- function(x, ...) {
-  shape <- mx.nd.shape(x)          # row-major dims
-  n <- prod(shape)
-  r <- .mxr.status(.C("mxr_nd_get", as.integer(x), data = double(n),
-                      as.integer(n), status = integer(1)))
-  # back to column-major R array with the logical dims
-  aperm(array(r$data, dim = rev(shape)), rev(seq_along(shape)))
-}
-
-mx.nd.set <- function(h, data) {
-  dims <- dim(data)
-  if (is.null(dims)) dims <- length(data)
-  rowmajor <- aperm(array(data, dims), rev(seq_along(dims)))
-  invisible(.mxr.status(.C("mxr_nd_set", as.integer(h), as.double(rowmajor),
-                           as.integer(length(rowmajor)),
-                           status = integer(1))))
-}
-
-mx.nd.free <- function(h) {
-  invisible(.C("mxr_nd_free", as.integer(h), status = integer(1)))
-}
-
-# ------------------------------------------------------------------- Symbol
-
-mx.symbol.Variable <- function(name) {
-  r <- .mxr.status(.C("mxr_sym_variable", as.character(name),
-                      id = integer(1), status = integer(1)))
-  structure(r$id, class = "mxtpu.symbol")
-}
-
-# generic operator constructor: mx.symbol.op("FullyConnected",
-#   data = prev_symbol, num_hidden = 10, name = "fc1")
-mx.symbol.op <- function(opname, ..., name = "") {
-  all_args <- list(...)
-  is_sym <- vapply(all_args, inherits, logical(1), "mxtpu.symbol")
-  params <- all_args[!is_sym]
-  inputs <- all_args[is_sym]
-  r <- .mxr.status(.C("mxr_sym_atomic", as.character(opname),
-                      as.integer(length(params)),
-                      as.character(names(params)),
-                      as.character(vapply(params, function(p)
-                        paste0(as.character(p), collapse = ","),
-                        character(1))),
-                      id = integer(1), status = integer(1)))
-  sym <- structure(r$id, class = "mxtpu.symbol")
-  .mxr.status(.C("mxr_sym_compose", as.integer(sym), as.character(name),
-                 as.integer(length(inputs)), as.character(names(inputs)),
-                 as.integer(unlist(inputs)), status = integer(1)))
-  sym
-}
-
-# per-operator wrappers (mx.symbol.FullyConnected, mx.symbol.Convolution,
-# ...) are AUTOGENERATED over mx.symbol.op for the whole registry —
-# source R/mxtpu_generated.R (built by tools/gen_r_ops.py, the
-# mxnet_generated.R analog)
-
-mx.symbol.arguments <- function(sym) {
-  buf <- paste(rep(" ", 65536L), collapse = "")
-  r <- .mxr.status(.C("mxr_sym_arguments", as.integer(sym),
-                      out = as.character(buf), as.integer(65536L),
-                      status = integer(1)))
-  strsplit(r$out, "\n")[[1]]
-}
-
-mx.symbol.aux <- function(sym) {
-  buf <- paste(rep(" ", 65536L), collapse = "")
-  r <- .mxr.status(.C("mxr_sym_aux", as.integer(sym),
-                      out = as.character(buf), as.integer(65536L),
-                      status = integer(1)))
-  out <- strsplit(r$out, "\n")[[1]]
-  out[nchar(out) > 0]
-}
-
-mx.symbol.tojson <- function(sym) {
-  buf <- paste(rep(" ", 1048576L), collapse = "")
-  r <- .mxr.status(.C("mxr_sym_tojson", as.integer(sym),
-                      out = as.character(buf), as.integer(1048576L),
-                      status = integer(1)))
-  r$out
-}
-
-mx.symbol.fromjson <- function(js) {
-  r <- .mxr.status(.C("mxr_sym_fromjson", as.character(js), id = integer(1),
-                      status = integer(1)))
-  structure(r$id, class = "mxtpu.symbol")
-}
-
-mx.symbol.infer.shapes <- function(sym, data_shape, data_name = "data",
-                                   max_args = 1024L) {
-  r <- .mxr.status(.C("mxr_sym_infer_shapes", as.integer(sym),
-                      as.character(data_name), as.integer(data_shape),
-                      as.integer(length(data_shape)),
-                      as.integer(max_args),
-                      n_args = integer(1), arg_ndims = integer(max_args),
-                      arg_shapes = integer(max_args * 8),
-                      n_aux = integer(1), aux_ndims = integer(max_args),
-                      aux_shapes = integer(max_args * 8),
-                      status = integer(1)))
-  get_shapes <- function(n, ndims, shapes) {
-    lapply(seq_len(n), function(i)
-      shapes[((i - 1) * 8 + 1):((i - 1) * 8 + ndims[i])])
-  }
-  list(arg_shapes = get_shapes(r$n_args, r$arg_ndims, r$arg_shapes),
-       aux_shapes = get_shapes(r$n_aux, r$aux_ndims, r$aux_shapes))
-}
-
-# ----------------------------------------------------------------- Executor
-
-mx.executor.bind <- function(sym, arg_ids, grad_ids, reqs, aux_ids) {
-  r <- .mxr.status(.C("mxr_exec_bind", as.integer(sym),
-                      as.integer(length(arg_ids)), as.integer(arg_ids),
-                      as.integer(grad_ids), as.integer(reqs),
-                      as.integer(length(aux_ids)), as.integer(aux_ids),
-                      id = integer(1), status = integer(1)))
-  structure(r$id, class = "mxtpu.executor")
-}
-
-mx.executor.forward <- function(ex, is.train = FALSE) {
-  invisible(.mxr.status(.C("mxr_exec_forward", as.integer(ex),
-                           as.integer(is.train), status = integer(1))))
-}
-
-mx.executor.backward <- function(ex) {
-  invisible(.mxr.status(.C("mxr_exec_backward", as.integer(ex),
-                           status = integer(1))))
-}
-
-mx.executor.outputs <- function(ex) {
-  r <- .mxr.status(.C("mxr_exec_outputs", as.integer(ex),
-                      ids = integer(64), n = integer(1),
-                      status = integer(1)))
-  lapply(seq_len(r$n), function(i)
-    structure(r$ids[i], class = "mxtpu.ndarray"))
-}
+# The binding's module layout mirrors the reference's R-package/R/ split:
+#   ndarray.R symbol.R executor.R    (moved from this file, round 5)
+#   model.R optimizer.R io.R kvstore.R initializer.R metric.R callback.R
+#   mxtpu_generated.R                (autogen op wrappers)
+# This file keeps the shared status/error helper, the RNG seed hook, and
+# the prediction entry (mx.model.predict) the inference demo uses.
 
 # -------------------------------------------------------------- FeedForward
 #

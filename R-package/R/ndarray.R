@@ -1,0 +1,51 @@
+# NDArray layer of the R binding (reference capability:
+# R-package/R/ndarray.R). Split out of mxtpu_train.R to mirror the
+# reference's module layout; all files source() into one namespace —
+# see demo/lenet_train.R for the canonical load order.
+
+# ------------------------------------------------------------------ NDArray
+
+mx.nd.array <- function(data) {
+  # R arrays are column-major; the runtime is row-major. aperm the data,
+  # keep the LOGICAL dims (same convention as mxtpu.R's predictor layer).
+  dims <- dim(data)
+  if (is.null(dims)) dims <- length(data)
+  r <- .mxr.status(.C("mxr_nd_create", as.integer(dims),
+                      as.integer(length(dims)), id = integer(1),
+                      status = integer(1)))
+  h <- structure(r$id, class = "mxtpu.ndarray", dims = dims)
+  rowmajor <- aperm(array(data, dims), rev(seq_along(dims)))
+  .mxr.status(.C("mxr_nd_set", as.integer(h), as.double(rowmajor),
+                 as.integer(length(rowmajor)), status = integer(1)))
+  h
+}
+
+mx.nd.zeros <- function(shape) mx.nd.array(array(0, dim = shape))
+
+mx.nd.shape <- function(h) {
+  r <- .mxr.status(.C("mxr_nd_shape", as.integer(h), ndim = integer(1),
+                      shape = integer(8), status = integer(1)))
+  r$shape[seq_len(r$ndim)]
+}
+
+as.array.mxtpu.ndarray <- function(x, ...) {
+  shape <- mx.nd.shape(x)          # row-major dims
+  n <- prod(shape)
+  r <- .mxr.status(.C("mxr_nd_get", as.integer(x), data = double(n),
+                      as.integer(n), status = integer(1)))
+  # back to column-major R array with the logical dims
+  aperm(array(r$data, dim = rev(shape)), rev(seq_along(shape)))
+}
+
+mx.nd.set <- function(h, data) {
+  dims <- dim(data)
+  if (is.null(dims)) dims <- length(data)
+  rowmajor <- aperm(array(data, dims), rev(seq_along(dims)))
+  invisible(.mxr.status(.C("mxr_nd_set", as.integer(h), as.double(rowmajor),
+                           as.integer(length(rowmajor)),
+                           status = integer(1))))
+}
+
+mx.nd.free <- function(h) {
+  invisible(.C("mxr_nd_free", as.integer(h), status = integer(1)))
+}

@@ -1,0 +1,16 @@
+# Package load hooks (reference capability: R-package/R/zzz.R — dyn.load
+# of the native library on attach and version banner).
+
+.onLoad <- function(libname, pkgname) {
+  lib <- file.path(libname, pkgname, "libs", "libmxtpu_r_train.so")
+  if (file.exists(lib)) dyn.load(lib)
+}
+
+.onAttach <- function(libname, pkgname) {
+  packageStartupMessage("mxtpu: TPU-native MXNet-compatible runtime")
+}
+
+.onUnload <- function(libpath) {
+  lib <- file.path(libpath, "libs", "libmxtpu_r_train.so")
+  if (file.exists(lib)) dyn.unload(lib)
+}

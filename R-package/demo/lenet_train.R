@@ -12,6 +12,9 @@
 
 dyn.load(file.path("src", "libmxtpu_r_train.so"))
 source(file.path("R", "mxtpu_train.R"))
+source(file.path("R", "ndarray.R"))
+source(file.path("R", "symbol.R"))
+source(file.path("R", "executor.R"))
 source(file.path("R", "mxtpu_generated.R"))
 source(file.path("R", "optimizer.R"))
 source(file.path("R", "initializer.R"))
