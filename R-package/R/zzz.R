@@ -1,9 +1,16 @@
-# Package load hooks (reference capability: R-package/R/zzz.R — dyn.load
-# of the native library on attach and version banner).
+# Package load hooks (reference capability: R-package/R/zzz.R — native
+# library load on attach and version banner).
+#
+# The INSTALLED package's native code is libs/mxtpu.so (src/Makevars
+# compiles the predict shim + standalone predictor; NAMESPACE's
+# useDynLib(mxtpu) plus library.dynam here load it). The TRAINING shim
+# (src/libmxtpu_r_train.so, which links the embedded-CPython runtime via
+# libmxtpu_capi) is a development artifact built next to the repo and
+# dyn.load'ed explicitly — see demo/lenet_train.R — because an installed
+# R library cannot carry the Python runtime dependency.
 
 .onLoad <- function(libname, pkgname) {
-  lib <- file.path(libname, pkgname, "libs", "libmxtpu_r_train.so")
-  if (file.exists(lib)) dyn.load(lib)
+  library.dynam("mxtpu", pkgname, libname)
 }
 
 .onAttach <- function(libname, pkgname) {
@@ -11,6 +18,5 @@
 }
 
 .onUnload <- function(libpath) {
-  lib <- file.path(libpath, "libs", "libmxtpu_r_train.so")
-  if (file.exists(lib)) dyn.unload(lib)
+  library.dynam.unload("mxtpu", libpath)
 }

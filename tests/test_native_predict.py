@@ -5,6 +5,8 @@ export a trained graph, reload through the dependency-free runtime, and
 check outputs agree with the framework's own forward.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -163,3 +165,41 @@ def test_embedding_bundle(tmp_path):
 def test_error_reporting(tmp_path):
     with pytest.raises(RuntimeError, match="failed to load bundle"):
         native_predict.NativePredictor(str(tmp_path / "missing.mxtpu"))
+
+
+def test_standalone_predict_python_module(tmp_path):
+    """predict/python/mxtpu_predict.py (reference: the ctypes-only
+    predict/python/mxnet_predict.py deployment artifact) must drive a
+    bundle with NO mxnet_tpu import of its own — verified by loading it
+    as a plain module file and comparing against the in-package
+    predictor."""
+    import importlib.util
+
+    mod_path = os.path.join(os.path.dirname(__file__), "..", "predict",
+                            "python", "mxtpu_predict.py")
+    spec = importlib.util.spec_from_file_location("mxtpu_predict", mod_path)
+    standalone = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(standalone)
+
+    # zero package dependency: its imports are ctypes/os/numpy only
+    with open(mod_path) as f:
+        src = f.read()
+    assert "import mxnet_tpu" not in src and "from mxnet_tpu" not in src
+
+    x = S.Variable("data")
+    out = S.SoftmaxOutput(S.FullyConnected(data=x, num_hidden=4, name="fc"),
+                          name="softmax")
+    rng = np.random.RandomState(5)
+    params = {"fc_weight": nd.array(rng.randn(4, 6).astype(np.float32)),
+              "fc_bias": nd.array(rng.randn(4).astype(np.float32))}
+    py_pred = Predictor(out, params, {}, input_names=["data"])
+    inp = rng.randn(3, 6).astype(np.float32)
+    py_pred.forward(data=inp)
+    expected = py_pred.get_output(0)
+    bundle = str(tmp_path / "m.mxtpu")
+    py_pred.export(bundle)
+
+    p = standalone.Predictor(bundle)
+    outs = p.predict({"data": inp})
+    assert len(outs) == 1
+    np.testing.assert_allclose(outs[0], expected, atol=2e-4, rtol=1e-3)
