@@ -71,6 +71,8 @@ from .monitor import Monitor
 from . import operator
 from . import model
 from .model import FeedForward
+from . import module as mod
+from .module import Module
 from . import bucketing
 from .bucketing import BucketingFeedForward, BucketSentenceIter
 from . import recordio

@@ -1,0 +1,279 @@
+"""Module API — the intermediate-level trainer the BASELINE north star
+names ("train end-to-end via module.fit()").
+
+The reference snapshot (late 2015) ships only the FeedForward estimator;
+the Module interface is the API its successor standardized on: explicit
+``bind → init_params → init_optimizer`` lifecycle with per-step
+``forward / backward / update`` under user control, plus a ``fit`` that
+drives them. Users porting newer-MXNet code get the surface they expect;
+internally it is a thin facade over the same TPU-native machinery
+FeedForward uses (Executor's residual-capturing split forward/backward,
+the optimizer registry's updater contract) — no second training path to
+keep correct.
+
+Typical use::
+
+    mod = mx.mod.Module(symbol, data_names=('data',),
+                        label_names=('softmax_label',))
+    mod.fit(train_iter, num_epoch=8, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.1, 'momentum': 0.9})
+    mod.score(val_iter, 'accuracy')
+
+or the explicit loop::
+
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1})
+    for batch in train_iter:
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from . import initializer as init_mod
+from . import metric as metric_mod
+from . import optimizer as opt_mod
+from .base import MXNetError
+from .callback import BatchEndParam
+from .context import cpu
+from .model import load_checkpoint, save_checkpoint
+
+
+class Module:
+    """Intermediate-level trainer over a loss-headed Symbol."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), context=None,
+                 logger=None):
+        self._symbol = symbol
+        self._data_names = tuple(data_names)
+        self._label_names = tuple(label_names or ())
+        self._context = context if context is not None else cpu()
+        self._logger = logger or logging
+        self._exec = None
+        self._updater = None
+        self._optimizer = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             grad_req="write"):
+        """Allocate buffers and bind the executor. ``data_shapes`` /
+        ``label_shapes`` are ``[(name, shape), ...]`` (a DataIter's
+        ``provide_data`` / ``provide_label`` slot in directly)."""
+        shapes = dict(data_shapes)
+        if label_shapes:
+            shapes.update(dict(label_shapes))
+        # declared label names are ALWAYS inputs, even when the caller
+        # forgot label_shapes: infer their shapes so they never become
+        # "parameters" the optimizer would silently update while forward
+        # drops the batch's real labels
+        arg_names = self._symbol.list_arguments()
+        missing_labels = [n for n in self._label_names
+                          if n in arg_names and n not in shapes]
+        if missing_labels:
+            arg_shapes, _, _ = self._symbol.infer_shape(**shapes)
+            inferred = dict(zip(arg_names, arg_shapes))
+            for n in missing_labels:
+                shapes[n] = inferred[n]
+        if not for_training:
+            grad_req = "null"
+        if grad_req != "null":
+            # inputs/labels carry no gradient buffers
+            grad_req = {n: grad_req for n in self._symbol.list_arguments()
+                        if n not in shapes}
+        self._exec = self._symbol.simple_bind(self._context,
+                                              grad_req=grad_req, **shapes)
+        self._shapes = shapes
+        self.binded = True
+        self.for_training = for_training
+        return self
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False):
+        """Initialize parameters in place (name-dispatch through the
+        initializer registry, like FeedForward._init_params)."""
+        if not self.binded:
+            raise MXNetError("init_params requires bind() first")
+        if self.params_initialized and not force_init:
+            return self
+        if arg_params is None and aux_params is None:
+            pending = getattr(self, "_pending_params", None)
+            if pending:  # Module.load: checkpoint params win over the rng
+                arg_params, aux_params = pending
+        initializer = initializer if initializer is not None \
+            else init_mod.Uniform(0.01)
+        for name, arr in self._exec.arg_dict.items():
+            if name in self._shapes:
+                continue
+            if arg_params and name in arg_params:
+                arg_params[name].copyto(arr)
+            elif not allow_missing:
+                initializer(name, arr)
+        for name, arr in self._exec.aux_dict.items():
+            if aux_params and name in aux_params:
+                aux_params[name].copyto(arr)
+            elif not (allow_missing and aux_params):
+                # with allow_missing + explicit aux_params, absent aux
+                # states keep their current values (e.g. BN running stats
+                # from a restore) instead of being clobbered by the rng
+                initializer(name, arr)
+        self.params_initialized = True
+        return self
+
+    def init_optimizer(self, optimizer="sgd", optimizer_params=None,
+                       force_init=False):
+        if not self.params_initialized:
+            raise MXNetError("init_optimizer requires init_params() first")
+        if self.optimizer_initialized and not force_init:
+            return self
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        self._param_names = [n for n in self._symbol.list_arguments()
+                             if n not in self._shapes]
+        self.optimizer_initialized = True
+        return self
+
+    # -- per-step -------------------------------------------------------------
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(getattr(data_batch, "data_names",
+                                     self._data_names), data_batch.data):
+            feed[name] = arr
+        labels = getattr(data_batch, "label", None) or []
+        for name, arr in zip(getattr(data_batch, "label_names",
+                                     self._label_names), labels):
+            if name in self._shapes:
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+        return self
+
+    def backward(self):
+        self._exec.backward()
+        return self
+
+    def update(self):
+        """Apply one optimizer step to every bound parameter from its
+        gradient buffer (updater contract: optimizer.py get_updater)."""
+        if not self.optimizer_initialized:
+            raise MXNetError("update requires init_optimizer() first")
+        # num_update bookkeeping lives in Optimizer.update (one step = one
+        # update across all indices, the reference's _index_update_count)
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            self._updater(i, grad, self._exec.arg_dict[name])
+        return self
+
+    def get_outputs(self):
+        return self._exec.outputs
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self._exec.outputs[:max(1, len(labels))])
+
+    # -- params ---------------------------------------------------------------
+
+    def get_params(self):
+        arg = {n: a.copy() for n, a in self._exec.arg_dict.items()
+               if n not in self._shapes}
+        aux = {n: a.copy() for n, a in self._exec.aux_dict.items()}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=True, force_init=True)
+
+    def save_checkpoint(self, prefix, epoch):
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+
+    @staticmethod
+    def load(prefix, epoch, **kwargs):
+        symbol, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._pending_params = (arg, aux)
+        return mod
+
+    # -- high level -----------------------------------------------------------
+
+    def fit(self, train_data, eval_data=None, eval_metric="accuracy",
+            initializer=None, optimizer="sgd", optimizer_params=None,
+            num_epoch=1, batch_end_callback=None, epoch_end_callback=None):
+        """The north-star entry point: bind/init/train in one call."""
+        if not self.binded:
+            self.bind(train_data.provide_data, train_data.provide_label)
+        if not self.params_initialized:
+            self.init_params(initializer)  # consumes Module.load's
+            # checkpoint params when present
+        if not self.optimizer_initialized:
+            self.init_optimizer(optimizer, optimizer_params)
+        eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            nbatch = 0
+            train_data.reset()
+            for batch in train_data:
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                nbatch += 1
+                if batch_end_callback is not None:
+                    batch_end_callback(BatchEndParam(
+                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric))
+            name, value = eval_metric.get()
+            self._logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
+            self._logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                              time.time() - tic)
+            if eval_data is not None:
+                name, value = self.score(eval_data, eval_metric)
+                self._logger.info("Epoch[%d] Validation-%s=%f", epoch, name,
+                                  value)
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                epoch_end_callback(epoch, self._symbol, arg, aux)
+        return self
+
+    def score(self, eval_data, eval_metric="accuracy"):
+        eval_metric = metric_mod.create(eval_metric) \
+            if isinstance(eval_metric, str) else eval_metric
+        eval_metric.reset()
+        eval_data.reset()
+        for batch in eval_data:
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get()
+
+    def predict(self, eval_data):
+        """Stacked outputs over the iterator (first output head)."""
+        outs = []
+        eval_data.reset()
+        for batch in eval_data:
+            self.forward(batch, is_train=False)
+            pad = getattr(batch, "pad", 0)
+            arr = self._exec.outputs[0].asnumpy()
+            outs.append(arr[:len(arr) - pad] if pad else arr)
+        return np.concatenate(outs, axis=0)
