@@ -173,9 +173,14 @@ class Module:
         self._exec.backward()
         return self
 
-    def update(self):
+    def update(self, kvstore=None):
         """Apply one optimizer step to every bound parameter from its
-        gradient buffer (updater contract: optimizer.py get_updater)."""
+        gradient buffer (updater contract: optimizer.py get_updater).
+
+        With ``kvstore``, gradients round through the store first
+        (push i -> pull i), so a 'local'/'device' store merges multi-source
+        pushes and a 'dist_*' store aggregates across workers before the
+        local update — update-on-worker semantics."""
         if not self.optimizer_initialized:
             raise MXNetError("update requires init_optimizer() first")
         # num_update bookkeeping lives in Optimizer.update (one step = one
@@ -184,6 +189,9 @@ class Module:
             grad = self._exec.grad_dict.get(name)
             if grad is None:
                 continue
+            if kvstore is not None:
+                kvstore.push(i, grad)
+                kvstore.pull(i, grad)
             self._updater(i, grad, self._exec.arg_dict[name])
         return self
 
@@ -220,8 +228,11 @@ class Module:
 
     def fit(self, train_data, eval_data=None, eval_metric="accuracy",
             initializer=None, optimizer="sgd", optimizer_params=None,
-            num_epoch=1, batch_end_callback=None, epoch_end_callback=None):
-        """The north-star entry point: bind/init/train in one call."""
+            num_epoch=1, kvstore=None, batch_end_callback=None,
+            epoch_end_callback=None):
+        """The north-star entry point: bind/init/train in one call.
+        ``kvstore`` (a KVStore instance) routes gradients through the
+        store each step — see :meth:`update`."""
         if not self.binded:
             self.bind(train_data.provide_data, train_data.provide_label)
         if not self.params_initialized:
@@ -229,6 +240,9 @@ class Module:
             # checkpoint params when present
         if not self.optimizer_initialized:
             self.init_optimizer(optimizer, optimizer_params)
+        if kvstore is not None:
+            for i, name in enumerate(self._param_names):
+                kvstore.init(i, self._exec.arg_dict[name])
         eval_metric = metric_mod.create(eval_metric)
         for epoch in range(num_epoch):
             tic = time.time()
@@ -238,7 +252,7 @@ class Module:
             for batch in train_data:
                 self.forward(batch, is_train=True)
                 self.backward()
-                self.update()
+                self.update(kvstore=kvstore)
                 self.update_metric(eval_metric, batch.label)
                 nbatch += 1
                 if batch_end_callback is not None:

@@ -116,3 +116,17 @@ def test_module_bind_without_label_shapes_keeps_labels_as_inputs():
             mod.update_metric(metric, batch.label)
     _, acc = metric.get()
     assert acc > 0.95, acc  # real labels flowed: training converged
+
+
+def test_module_fit_with_kvstore():
+    """Gradients round through a kvstore each step (push/pull before the
+    local update) — the update-on-worker aggregation path."""
+    X, y = _dataset(seed=11)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp())
+    kv = mx.kv.create("local")
+    mod.fit(it, num_epoch=6, initializer=mx.init.Xavier(), kvstore=kv,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1 / 32.0})
+    _, acc = mod.score(mx.io.NDArrayIter(X, y, batch_size=32))
+    assert acc > 0.95, acc
