@@ -45,9 +45,9 @@ mx.model.save <- function(model, prefix, iteration) {
                            as.character(names), status = integer(1))))
 }
 
-# returns list(symbol, arg_names, args (nd ids incl. fresh data/label
-# slots = 0), aux_names, auxs): enough to rebuild an executor via
-# mx.model.bind or predict via mx.model.predict after re-binding.
+# returns list(symbol, arg_params, aux_params) — named ndarray-id lists;
+# hand the result to mx.model.bind(loaded, data_shape) to get a
+# predict-ready model (mx.model.predict consumes its executor).
 mx.model.load <- function(prefix, iteration) {
   json <- paste(readLines(paste0(prefix, "-symbol.json")), collapse = "\n")
   symbol <- mx.symbol.fromjson(json)
@@ -70,6 +70,44 @@ mx.model.load <- function(prefix, iteration) {
     }
   }
   list(symbol = symbol, arg_params = arg_params, aux_params = aux_params)
+}
+
+# Rebuild a forward-ready model from mx.model.load's result: bind an
+# executor over the loaded parameter ndarrays (no gradient buffers),
+# zero-filled data/label slots sized from `data_shape` (row-major,
+# batch first). The returned structure feeds mx.model.predict.
+mx.model.bind <- function(loaded, data_shape) {
+  symbol <- loaded$symbol
+  arg_names <- mx.symbol.arguments(symbol)
+  shapes <- mx.symbol.infer.shapes(symbol, data_shape)
+  args <- integer(length(arg_names))
+  for (i in seq_along(arg_names)) {
+    nm <- arg_names[i]
+    if (!is.null(loaded$arg_params[[nm]])) {
+      args[i] <- loaded$arg_params[[nm]]
+    } else {
+      shp <- shapes$arg_shapes[[i]]
+      args[i] <- .mxr.nd.from.host(shp, rep(0, prod(shp)))
+    }
+  }
+  aux_names <- mx.symbol.aux(symbol)
+  auxs <- integer(0)
+  if (length(aux_names) > 0) {
+    auxs <- vapply(seq_along(aux_names), function(i) {
+      nm <- aux_names[i]
+      if (!is.null(loaded$aux_params[[nm]])) {
+        loaded$aux_params[[nm]]
+      } else {
+        shp <- shapes$aux_shapes[[i]]
+        .mxr.nd.from.host(shp, rep(0, prod(shp)))
+      }
+    }, integer(1))
+  }
+  ex <- mx.executor.bind(symbol, args, integer(length(arg_names)),
+                         integer(length(arg_names)), auxs)
+  structure(list(executor = ex, arg_names = arg_names, args = args,
+                 aux_names = aux_names, auxs = auxs, symbol = symbol),
+            class = "mxtpu.model")
 }
 
 # Train `symbol` on X (R dim order, sample axis LAST) / y. The kv argument

@@ -73,7 +73,9 @@ stopifnot(model$train_acc > 0.9)
 mx.model.save(model, file.path(tempdir(), "lenet_r"), 8)
 loaded <- mx.model.load(file.path(tempdir(), "lenet_r"), 8)
 stopifnot(length(loaded$arg_params) == 6)  # c1/fc1/fc2 weight+bias
-cat("checkpoint save/load round-trip OK\n")
+bound <- mx.model.bind(loaded, c(32L, 1L, 8L, 8L))
+prob2 <- mx.model.predict(bound, X, batch.size = 32)
+cat("checkpoint save/load/bind/predict round-trip OK\n")
 
 # --- predict + symbol JSON round-trip ---------------------------------------
 prob <- mx.model.predict(model, X, batch.size = 32)  # N x classes

@@ -276,9 +276,19 @@ class _FeedBatchView:
 
 
 def _snapshot_batch(batch):
-    label = [NDArray(np.array(l.data, copy=True))
-             if isinstance(getattr(l, "data", None), np.ndarray) else l
-             for l in batch.label]
+    label = []
+    for l in batch.label:
+        data = getattr(l, "data", None)
+        if isinstance(data, np.ndarray):
+            # numpy-backed: the iterator may rewrite the buffer in place
+            label.append(NDArray(np.array(data, copy=True)))
+        elif data is not None:
+            # jax-backed: values are immutable, but a recycling iterator
+            # can REBIND the holder's ._data — pin the current array in a
+            # fresh holder (no copy needed)
+            label.append(NDArray(data))
+        else:  # pragma: no cover - non-NDArray labels pass through
+            label.append(l)
     return _FeedBatchView(batch, label)
 
 

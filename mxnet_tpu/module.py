@@ -119,20 +119,29 @@ class Module:
                 arg_params, aux_params = pending
         initializer = initializer if initializer is not None \
             else init_mod.Uniform(0.01)
+        # allow_missing semantics (reference Module contract): with an
+        # explicit param dict, a missing entry is an ERROR unless
+        # allow_missing=True, in which case the initializer fills it; with
+        # no dict at all, everything initializes.
         for name, arr in self._exec.arg_dict.items():
             if name in self._shapes:
                 continue
             if arg_params and name in arg_params:
                 arg_params[name].copyto(arr)
-            elif not allow_missing:
+            elif arg_params and not allow_missing:
+                raise MXNetError(
+                    f"init_params: {name!r} missing from arg_params "
+                    "(pass allow_missing=True to initialize it)")
+            else:
                 initializer(name, arr)
         for name, arr in self._exec.aux_dict.items():
             if aux_params and name in aux_params:
                 aux_params[name].copyto(arr)
-            elif not (allow_missing and aux_params):
-                # with allow_missing + explicit aux_params, absent aux
-                # states keep their current values (e.g. BN running stats
-                # from a restore) instead of being clobbered by the rng
+            elif aux_params:
+                # absent aux states keep their current values (e.g. BN
+                # running stats from a restore) — never rng-clobbered
+                continue
+            else:
                 initializer(name, arr)
         self.params_initialized = True
         return self
@@ -179,10 +188,23 @@ class Module:
 
         With ``kvstore``, gradients round through the store first
         (push i -> pull i), so a 'local'/'device' store merges multi-source
-        pushes and a 'dist_*' store aggregates across workers before the
-        local update — update-on-worker semantics."""
+        pushes and a 'dist_sync' store aggregates across workers before
+        the local update — update-on-worker semantics. Stores running a
+        SERVER-side updater (dist_async, or set_optimizer/set_updater on
+        any store) are rejected: their pull returns WEIGHTS, which this
+        path would mis-apply as gradients — use FeedForward for
+        update-on-kvstore training."""
         if not self.optimizer_initialized:
             raise MXNetError("update requires init_optimizer() first")
+        if kvstore is not None and (
+                getattr(kvstore, "type", "") == "dist_async"
+                or getattr(kvstore, "_updater", None) is not None):
+            raise MXNetError(
+                "Module.update routes gradients through the store "
+                "(update-on-worker); this kvstore runs an updater on the "
+                "store side (update-on-kvstore) — its pull returns "
+                "weights, not gradients. Use FeedForward.fit for "
+                "dist_async / set_optimizer stores.")
         # num_update bookkeeping lives in Optimizer.update (one step = one
         # update across all indices, the reference's _index_update_count)
         for i, name in enumerate(self._param_names):
@@ -198,8 +220,16 @@ class Module:
     def get_outputs(self):
         return self._exec.outputs
 
-    def update_metric(self, eval_metric, labels):
-        eval_metric.update(labels, self._exec.outputs[:max(1, len(labels))])
+    def update_metric(self, eval_metric, labels, pad=0):
+        """Feed the step's outputs to the metric; ``pad`` wrap-around
+        samples of a final partial batch are excluded (same de-pad
+        discipline as predict and FeedForward._eval)."""
+        outs = self._exec.outputs[:max(1, len(labels))]
+        if pad:
+            keep = len(labels[0]) - pad if labels else None
+            labels = [l[:keep] for l in labels]
+            outs = [o[:keep] for o in outs]
+        eval_metric.update(labels, outs)
 
     # -- params ---------------------------------------------------------------
 
@@ -238,11 +268,34 @@ class Module:
         if not self.params_initialized:
             self.init_params(initializer)  # consumes Module.load's
             # checkpoint params when present
-        if not self.optimizer_initialized:
+        fresh_optimizer = not self.optimizer_initialized
+        if fresh_optimizer:
             self.init_optimizer(optimizer, optimizer_params)
-        if kvstore is not None:
+        if kvstore is not None and kvstore.num_workers > 1 and \
+                fresh_optimizer:
+            # the pulled gradient is the SUM across workers: fold
+            # num_workers into the rescale, like FeedForward.fit does
+            # (model.py: rescale_grad = 1/(batch_size*num_workers))
+            self._optimizer.rescale_grad /= kvstore.num_workers
+        if kvstore is not None and not getattr(self, "_kv_ready", False):
+            import jax
+
+            if kvstore.num_workers > 1 and jax.process_count() > 1:
+                # rank 0's initialization wins, or per-process RNGs would
+                # silently train diverged replicas (same guard as
+                # FeedForward.fit / reference kvstore_dist.h:49-60)
+                from jax.experimental import multihost_utils
+
+                from .ndarray import NDArray
+
+                names = list(self._param_names)
+                flat = multihost_utils.broadcast_one_to_all(tuple(
+                    self._exec.arg_dict[n].asnumpy() for n in names))
+                for n, v in zip(names, flat):
+                    NDArray(np.asarray(v)).copyto(self._exec.arg_dict[n])
             for i, name in enumerate(self._param_names):
                 kvstore.init(i, self._exec.arg_dict[name])
+            self._kv_ready = True
         eval_metric = metric_mod.create(eval_metric)
         for epoch in range(num_epoch):
             tic = time.time()
@@ -253,7 +306,8 @@ class Module:
                 self.forward(batch, is_train=True)
                 self.backward()
                 self.update(kvstore=kvstore)
-                self.update_metric(eval_metric, batch.label)
+                self.update_metric(eval_metric, batch.label,
+                                   pad=getattr(batch, "pad", 0))
                 nbatch += 1
                 if batch_end_callback is not None:
                     batch_end_callback(BatchEndParam(
@@ -278,7 +332,8 @@ class Module:
         eval_data.reset()
         for batch in eval_data:
             self.forward(batch, is_train=False)
-            self.update_metric(eval_metric, batch.label)
+            self.update_metric(eval_metric, batch.label,
+                               pad=getattr(batch, "pad", 0))
         return eval_metric.get()
 
     def predict(self, eval_data):

@@ -46,8 +46,13 @@ OVERLAP = 0.7          # fraction of all-reduce hidden under backward
 def allreduce_bytes_from_hlo(n_dev=8):
     """Compile the dp ResNet-50 train step over an n_dev virtual mesh and
     sum the all-reduce payload bytes from the optimized HLO."""
-    os.environ.setdefault(
-        "XLA_FLAGS", f"--xla_force_host_platform_device_count={n_dev}")
+    flag = f"--xla_force_host_platform_device_count={n_dev}"
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # append, never setdefault: a pre-set XLA_FLAGS without the device
+        # count would otherwise leave one CPU device and break the mesh
+        os.environ["XLA_FLAGS"] = \
+            (os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
     import jax
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
