@@ -56,6 +56,29 @@ def test_dist_sync_mlp_2proc():
 
 
 @pytest.mark.slow
+def test_dist_sync_module_2proc():
+    """Module API across 2 launched processes: kvstore-routed gradients,
+    rank-0 init broadcast (per-rank seeds differ on purpose), num_workers
+    rescale — both workers converge AND hold identical weights."""
+    script = os.path.join(REPO, "examples", "distributed",
+                          "dist_sync_module.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", sys.executable, script],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert res.stdout.count("dist_sync_module accuracy") == 2, \
+        res.stdout + res.stderr[-2000:]
+    # identical replicas: both ranks print the same weight digest
+    import re as _re
+
+    digests = _re.findall(r"wsum = ([\d.]+)", res.stdout)
+    assert len(digests) == 2 and digests[0] == digests[1], res.stdout
+
+
+@pytest.mark.slow
 def test_dist_sync_lenet_2proc():
     """Launched CONV-NET train-to-accuracy tier (reference:
     multi-node/dist_sync_lenet.py): 2 real processes, LeNet on deterministic
