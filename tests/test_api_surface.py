@@ -23,6 +23,9 @@ REFERENCE_SURFACE = {
     "lr_scheduler": ["LearningRateScheduler", "FactorScheduler"],
     "metric": ["EvalMetric", "Accuracy", "CustomMetric", "create"],
     "model": ["save_checkpoint", "load_checkpoint", "FeedForward"],
+    # extension beyond the v0.5 reference: the successor's Module API
+    # (BASELINE north star names module.fit())
+    "mod": ["Module"],
     "name": ["NameManager", "Prefix"],
     "nd": ["NDArray", "onehot_encode", "empty", "zeros", "ones", "array",
            "load", "save"],
