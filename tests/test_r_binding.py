@@ -561,3 +561,77 @@ def test_r_shim_kvstore(train_shim):
     st = _p_int(1)
     lib.mxr_kv_free(_p_int(kv[0]), st)
     _st(lib, None, st)
+
+
+def test_r_shim_load_bind_predict_sequence(train_shim, tmp_path):
+    """The exact call sequence R's mx.model.load -> mx.model.bind ->
+    mx.model.predict emits (model.R): load a Python-written checkpoint
+    through the shim, bind an executor over the LOADED parameter handles
+    (no grad buffers), forward a batch, and match the Python executor's
+    output."""
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+
+    lib = train_shim
+    nd_create, nd_set, nd_get = _shim_nd_helpers(lib)
+    rng = np.random.RandomState(9)
+
+    # train-free checkpoint written by the PYTHON layer
+    net = S.SoftmaxOutput(S.FullyConnected(
+        data=S.Variable("data"), num_hidden=3, name="fc"), name="softmax")
+    w = rng.randn(3, 5).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    from mxnet_tpu.model import save_checkpoint
+
+    save_checkpoint(str(tmp_path / "m"), 1, net,
+                    {"fc_weight": nd.array(w), "fc_bias": nd.array(b)}, {})
+
+    # R sequence 1: symbol from the json file
+    with open(str(tmp_path / "m-symbol.json")) as f:
+        js = f.read()
+    sym_id, st = _p_int(0), _p_int(1)
+    lib.mxr_sym_fromjson(_p_str(js), sym_id, st)
+    _st(lib, None, st)
+
+    # R sequence 2: params from the container
+    n_out = _p_int(0)
+    ids = (ctypes.c_int * 16)()
+    buf = ctypes.create_string_buffer(1 << 12)
+    pbuf = ctypes.cast(ctypes.pointer(ctypes.c_char_p(ctypes.addressof(buf))),
+                       ctypes.POINTER(ctypes.c_char_p))
+    st = _p_int(1)
+    lib.mxr_nd_load(_p_str(str(tmp_path / "m-0001.params")), _p_int(16),
+                    n_out, ids, pbuf, _p_int(1 << 12), st)
+    _st(lib, None, st)
+    by_name = {buf.value.decode().split("\n")[i]: ids[i]
+               for i in range(n_out[0])}
+
+    # R sequence 3: bind with loaded ids + fresh zero data/label slots,
+    # reqs all 0, grads all 0 (mx.model.bind)
+    h_data, h_label = nd_create([4, 5]), nd_create([4])
+    args = [h_data, by_name["arg:fc_weight"], by_name["arg:fc_bias"],
+            h_label]
+    ex, st = _p_int(0), _p_int(1)
+    lib.mxr_exec_bind(_p_int(sym_id[0]), _p_int(4), _p_int(*args),
+                      _p_int(0, 0, 0, 0), _p_int(0, 0, 0, 0),
+                      _p_int(0), _p_int(0), ex, st)
+    _st(lib, None, st)
+
+    # R sequence 4: predict
+    X = rng.randn(4, 5).astype(np.float64)
+    nd_set(h_data, X)
+    st = _p_int(1)
+    lib.mxr_exec_forward(ex, _p_int(0), st)
+    _st(lib, None, st)
+    outs = (ctypes.c_int * 64)()
+    n = _p_int(0)
+    st = _p_int(1)
+    lib.mxr_exec_outputs(ex, outs, n, st)
+    _st(lib, None, st)
+    got = nd_get(outs[0], 4 * 3).reshape(4, 3)
+
+    logits = X.astype(np.float32) @ w.T + b
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    expected = e / e.sum(1, keepdims=True)
+    np.testing.assert_allclose(got, expected, atol=2e-4, rtol=1e-3)
