@@ -346,3 +346,115 @@ class Module:
             arr = self._exec.outputs[0].asnumpy()
             outs.append(arr[:len(arr) - pad] if pad else arr)
         return np.concatenate(outs, axis=0)
+
+
+class BucketingModule(Module):
+    """Module over a symbol FACTORY: one executor per bucket key, all
+    sharing the default bucket's parameter (and gradient) arrays — the
+    successor API's BucketingModule, over the same per-shape-jit-cache
+    design BucketingFeedForward uses (reference capability:
+    example/rnn/lstm.py's executor-per-seq-len binding).
+
+    ``sym_gen(bucket_key) -> Symbol``; batches must carry ``bucket_key``
+    plus per-bucket ``data_names``/``label_names`` (BucketSentenceIter's
+    protocol). Sharing works because every bucket's parameter names and
+    shapes coincide (an unrolled RNN reuses one weight set at every
+    length)."""
+
+    def __init__(self, sym_gen, default_bucket_key, context=None,
+                 logger=None):
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        super().__init__(sym_gen(default_bucket_key), data_names=(),
+                         label_names=(), context=context, logger=logger)
+        self._bucket_execs = {}
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training, grad_req)
+        self._grad_req = grad_req  # bucket executors honor the same policy
+        self._bucket_execs = {self._default_key: self._exec}
+        self._default_exec = self._exec
+        return self
+
+    def _executor_for(self, key, shapes):
+        """Bind `key`'s symbol over the DEFAULT executor's parameter/grad
+        NDArrays (shared objects: the updater's in-place _set_data is
+        visible to every bucket) with fresh input buffers."""
+        from .executor import Executor
+        from .ndarray import zeros
+
+        sym = self._sym_gen(key)
+        arg_names = sym.list_arguments()
+        # the batch only describes this bucket's inputs; shared arguments
+        # (weights, RNN init states) take their known shapes from the
+        # default executor so inference is fully determined
+        known = dict(shapes)
+        for n in arg_names:
+            if n not in known and n in self._default_exec.arg_dict:
+                known[n] = tuple(self._default_exec.arg_dict[n].shape)
+        arg_shapes, _, aux_shapes = sym.infer_shape(**known)
+        args, grads, reqs = {}, {}, {}
+        for n, s in zip(arg_names, arg_shapes):
+            if n in shapes:
+                args[n] = zeros(s, self._context)
+                reqs[n] = "null"
+                continue
+            shared = self._default_exec.arg_dict.get(n)
+            if shared is None or tuple(shared.shape) != tuple(s):
+                raise MXNetError(
+                    f"bucket {key!r}: parameter {n!r} "
+                    + ("is absent from" if shared is None else
+                       f"has shape {tuple(s)} != "
+                       f"{tuple(shared.shape)} in")
+                    + " the default bucket — buckets must share one "
+                    "parameter set")
+            args[n] = shared
+            g = self._default_exec.grad_dict.get(n)
+            if g is not None:
+                grads[n] = g
+            # honor the user's bind-time policy (e.g. "add" accumulation)
+            reqs[n] = self._grad_req if g is not None else "null"
+        aux = {}
+        aux_names = sym.list_auxiliary_states()
+        for n, s in zip(aux_names, aux_shapes):
+            shared = self._default_exec.aux_dict.get(n)
+            if shared is None or tuple(shared.shape) != tuple(s):
+                raise MXNetError(
+                    f"bucket {key!r}: aux state {n!r} does not match the "
+                    "default bucket's — buckets must share one state set")
+            aux[n] = shared
+        return Executor(sym, self._context, args, grads, reqs, aux)
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", self._default_key)
+        labels = getattr(data_batch, "label", None) or []
+        label_names = getattr(data_batch, "label_names", ()) if labels \
+            else ()
+        if key not in self._bucket_execs:
+            shapes = dict(zip(data_batch.data_names,
+                              [tuple(a.shape) for a in data_batch.data]))
+            shapes.update(zip(label_names,
+                              [tuple(a.shape) for a in labels]))
+            self._bucket_execs[key] = self._executor_for(key, shapes)
+        self._exec = self._bucket_execs[key]
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(data_batch.data_names, data_batch.data):
+            feed[name] = arr
+        for name, arr in zip(label_names, labels):
+            if name in self._exec.arg_dict:
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+        return self
+
+    def update(self, kvstore=None):
+        # gradients live in the SHARED buffers regardless of which bucket
+        # ran the step; route the update through the default executor
+        current = self._exec
+        self._exec = self._default_exec
+        try:
+            return super().update(kvstore=kvstore)
+        finally:
+            self._exec = current
