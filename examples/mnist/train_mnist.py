@@ -44,6 +44,10 @@ def main():
     ap.add_argument("--kv-store", default="local")
     ap.add_argument("--num-devices", type=int, default=1)
     ap.add_argument("--cpu", action="store_true", help="force CPU platform")
+    ap.add_argument("--api", choices=["feedforward", "module"],
+                    default="feedforward",
+                    help="estimator API: FeedForward (reference parity) or "
+                         "Module (the BASELINE north star's module.fit())")
     args = ap.parse_args()
 
     if args.cpu:
@@ -75,6 +79,17 @@ def main():
         train = mx.io.NDArrayIter(X[:split], y[:split],
                                   batch_size=args.batch_size, shuffle=True)
         val = mx.io.NDArrayIter(X[split:], y[split:], batch_size=args.batch_size)
+
+    if args.api == "module":
+        mod = mx.mod.Module(net, context=mx.tpu() if not args.cpu
+                            else mx.cpu())
+        mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+                initializer=mx.init.Xavier(),
+                optimizer_params={"learning_rate": args.lr,
+                                  "momentum": args.momentum,
+                                  "rescale_grad": 1.0 / args.batch_size})
+        print("final val accuracy:", mod.score(val)[1])
+        return
 
     ctx = [mx.tpu(i) for i in range(args.num_devices)]
     model = mx.FeedForward(net, ctx=ctx, num_epoch=args.num_epochs,

@@ -27,3 +27,20 @@ def test_fgsm_adversary_example():
 @pytest.mark.slow
 def test_python_howto_example():
     _run("python_howto/basics.py")
+
+
+@pytest.mark.slow
+def test_train_mnist_module_api():
+    """The BASELINE north star's module.fit() through the mnist example
+    entry point (synthetic data, CPU)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "mnist", "train_mnist.py"),
+         "--network", "mlp", "--cpu", "--api", "module",
+         "--num-epochs", "4"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "final val accuracy: 1.0" in r.stdout, r.stdout[-500:]
