@@ -81,10 +81,15 @@ def main():
         val = mx.io.NDArrayIter(X[split:], y[split:], batch_size=args.batch_size)
 
     if args.api == "module":
+        if args.num_devices > 1:
+            logging.warning("--api module is single-device; use "
+                            "--api feedforward for multi-device dp "
+                            "(--num-devices ignored)")
+        kv = mx.kv.create(args.kv_store) if "dist" in args.kv_store else None
         mod = mx.mod.Module(net, context=mx.tpu() if not args.cpu
                             else mx.cpu())
         mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
-                initializer=mx.init.Xavier(),
+                initializer=mx.init.Xavier(), kvstore=kv,
                 optimizer_params={"learning_rate": args.lr,
                                   "momentum": args.momentum,
                                   "rescale_grad": 1.0 / args.batch_size})

@@ -210,37 +210,95 @@ class TransformerLM:
         return jnp.mean(nll)
 
     # -- fused, sharded train step --------------------------------------------
-    def make_train_step(self, mesh: Mesh | None, lr=1e-3):
-        """SGD-with-momentum train step, donated and sharded over the mesh."""
+    def _state_shardings(self, mesh, opt_state):
+        """Optimizer-state sharding tree: a leaf shaped like its parameter
+        inherits the parameter's sharding (Adam m/v); anything else (step
+        counters) replicates."""
+        pshard = self.param_shardings(mesh)
+        repl = NamedSharding(mesh, P())
+        return {
+            k: jax.tree_util.tree_map(
+                lambda leaf: pshard[k]
+                if getattr(leaf, "ndim", 0) > 0 else repl, opt_state[k])
+            for k in opt_state
+        }
+
+    def make_train_step(self, mesh: Mesh | None, lr=None, optimizer=None):
+        """Donated, sharded train step. ``optimizer=None`` keeps the
+        built-in SGD-momentum(0.9); any ``mxnet_tpu.optimizer.Optimizer``
+        (e.g. ``opt.create('adamw', ...)``) runs fused in the step via its
+        pure pytree path — pass the matching state from
+        ``init_sharded(..., optimizer=opt)``.
+
+        ``lr=None`` takes the optimizer's own lr (or 1e-3 for the
+        built-in). lr_schedulers are rejected: the fused step carries no
+        step counter — rebuild the step per phase (each build is a cache
+        hit for unchanged lr) or train via FeedForward for scheduling."""
+        from ..base import MXNetError
+
+        if optimizer is not None and optimizer.lr_scheduler is not None:
+            raise MXNetError(
+                "make_train_step: lr_scheduler is not consulted by the "
+                "fused step (no step counter); pass explicit lr per phase "
+                "or use FeedForward")
+        if lr is None:
+            lr = optimizer.lr if optimizer is not None else 1e-3
 
         def step(params, moms, tokens, targets):
             loss, grads = jax.value_and_grad(
                 lambda p: self.loss(p, tokens, targets, mesh=mesh)
             )(params)
-            new_moms = {k: 0.9 * moms[k] + grads[k] for k in params}
-            new_params = {k: params[k] - lr * new_moms[k] for k in params}
+            if optimizer is None:
+                new_moms = {k: 0.9 * moms[k] + grads[k] for k in params}
+                new_params = {k: params[k] - lr * new_moms[k]
+                              for k in params}
+            else:
+                new_params, new_moms = optimizer.apply(params, grads, moms,
+                                                       lr)
             return new_params, new_moms, loss
 
         if mesh is None:
             return jax.jit(step, donate_argnums=(0, 1))
         pshard = self.param_shardings(mesh)
+        if optimizer is None:
+            sshard = pshard
+        else:
+            # state sharding tree from a structural template (leaf SHAPES
+            # don't matter here — only the tree structure and leaf ndim)
+            template = optimizer.init_state_tree(
+                {k: jnp.zeros((2,), jnp.float32) for k in pshard})
+            sshard = self._state_shardings(mesh, template)
         dshard = NamedSharding(mesh, P("dp", "sp"))
         return jax.jit(
             step,
-            in_shardings=(pshard, pshard, dshard, dshard),
-            out_shardings=(pshard, pshard, NamedSharding(mesh, P())),
+            in_shardings=(pshard, sshard, dshard, dshard),
+            out_shardings=(pshard, sshard, NamedSharding(mesh, P())),
             donate_argnums=(0, 1),
         )
 
-    def init_sharded(self, mesh: Mesh | None, seed=0):
-        """Initialize params (and momentum buffers) directly with their target
-        shardings, so no single host materializes the full model."""
+    def init_sharded(self, mesh: Mesh | None, seed=0, optimizer=None):
+        """Initialize params (and optimizer state: momentum buffers for the
+        built-in SGD, or ``optimizer``'s state tree) directly with their
+        target shardings, so no single host materializes the full model."""
         params = self.init_params(jax.random.PRNGKey(seed))
         if mesh is None:
-            moms = {k: jnp.zeros_like(v) for k, v in params.items()}
-            return params, moms
+            if optimizer is None:
+                return params, {k: jnp.zeros_like(v)
+                                for k, v in params.items()}
+            return params, optimizer.init_state_tree(params)
         sh = self.param_shardings(mesh)
         params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
-        moms = {k: jax.device_put(jnp.zeros_like(v), sh[k])
-                for k, v in params.items()}
-        return params, moms
+        if optimizer is None:
+            state = {k: jnp.zeros_like(v) for k, v in params.items()}
+            return params, {k: jax.device_put(v, sh[k])
+                            for k, v in state.items()}
+        # structural template (tiny leaves) -> sharding tree, then create
+        # the REAL state directly with its target shardings inside jit, so
+        # no single device ever materializes the full unsharded state
+        # (Adam m/v are 2x the model in f32)
+        template = optimizer.init_state_tree(
+            {k: jnp.zeros((2,), jnp.float32) for k in params})
+        sshard = self._state_shardings(mesh, template)
+        state = jax.jit(optimizer.init_state_tree,
+                        out_shardings=sshard)(params)
+        return params, state

@@ -153,6 +153,11 @@ class Adam(Optimizer):
         return (jnp.zeros(w.shape, jnp.float32), jnp.zeros(w.shape, jnp.float32),
                 jnp.zeros((), jnp.float32))
 
+    def _step_update(self, w32, mhat, vhat, lr):
+        """The weight-update rule given bias-corrected moments (AdamW
+        overrides to add its decoupled decay term)."""
+        return w32 - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+
     def _apply_one(self, w, g, state, lr):
         g = self._preprocess(w, g)
         m_state, v_state, t_state = state
@@ -166,12 +171,35 @@ class Adam(Optimizer):
         v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
         mhat = m / (1 - self.beta1**t)
         vhat = v / (1 - self.beta2**t)
-        new_w = (w.astype(jnp.float32) - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)).astype(w.dtype)
+        new_w = self._step_update(w.astype(jnp.float32), mhat, vhat,
+                                  lr).astype(w.dtype)
         if isinstance(m_state, NDArray):
             m_state._set_data(m)
             v_state._set_data(v)
             return new_w, state
         return new_w, (m, v, t)
+
+
+@OPTIMIZERS.register("adamw")
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (capability extension; the
+    transformer-training default). Unlike Adam's L2-through-the-gradient
+    (``wd`` folded into g by _preprocess), the decay applies directly to
+    the weight, scaled by lr — the AdamW formulation. Moments/bias
+    correction are inherited; only the weight-update rule differs."""
+
+    def __init__(self, weight_decay=0.01, **kwargs):
+        if kwargs.get("wd"):
+            raise MXNetError(
+                "AdamW: use weight_decay (decoupled), not wd — passing wd "
+                "would ALSO apply L2 through the gradient, double-"
+                "regularizing")
+        super().__init__(**kwargs)
+        self.weight_decay = weight_decay
+
+    def _step_update(self, w32, mhat, vhat, lr):
+        return super()._step_update(w32, mhat, vhat, lr) \
+            - lr * self.weight_decay * w32
 
 
 @OPTIMIZERS.register("rmsprop")
