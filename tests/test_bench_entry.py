@@ -1,0 +1,32 @@
+"""Driver-entry guards: bench.py's host-only mode must stay runnable
+(the TPU modes need the tunnel, but argument parsing, RecordIO synthesis,
+the native pipeline, and the JSON contract are all exercisable on CPU —
+if this breaks, the driver's end-of-round capture breaks with it)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_pipeline_mode_json_contract(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode",
+         "pipeline", "--recordio", str(tmp_path / "b.rec"),
+         "--num-images", "64"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    # the contract: ONE JSON line on stdout with the required keys
+    lines = [l for l in r.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in blob, blob
+    assert blob["value"] > 0
