@@ -188,7 +188,7 @@ class AdamW(Adam):
     the weight, scaled by lr — the AdamW formulation. Moments/bias
     correction are inherited; only the weight-update rule differs."""
 
-    def __init__(self, weight_decay=0.01, **kwargs):
+    def __init__(self, weight_decay=0.01, decay_filter=None, **kwargs):
         if kwargs.get("wd"):
             raise MXNetError(
                 "AdamW: use weight_decay (decoupled), not wd — passing wd "
@@ -196,6 +196,24 @@ class AdamW(Adam):
                 "regularizing")
         super().__init__(**kwargs)
         self.weight_decay = weight_decay
+        # decay_filter(name) -> bool: False exempts a parameter (the
+        # standard recipe exempts biases/LayerNorm/embeddings). None
+        # decays everything. Name-aware masking rides the pytree path's
+        # per-name loop (Optimizer.apply), so it is trace-time static.
+        self.decay_filter = decay_filter
+
+    def apply(self, params, grads, states, lr):
+        if self.decay_filter is None:
+            return super().apply(params, grads, states, lr)
+        wd, new_p, new_s = self.weight_decay, {}, {}
+        try:
+            for k, w in params.items():
+                self.weight_decay = wd if self.decay_filter(k) else 0.0
+                new_p[k], new_s[k] = self._apply_one(w, grads[k],
+                                                     states[k], lr)
+        finally:
+            self.weight_decay = wd
+        return new_p, new_s
 
     def _step_update(self, w32, mhat, vhat, lr):
         return super()._step_update(w32, mhat, vhat, lr) \

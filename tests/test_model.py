@@ -368,3 +368,29 @@ def test_transformer_train_step_with_registry_optimizer():
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]  # memorizing one batch must descend
+
+
+def test_adamw_decay_filter_exempts_parameters():
+    """decay_filter masks decoupled decay per parameter NAME (standard
+    recipe: no decay on biases/LN) — exempted params match plain Adam's
+    trajectory, decayed ones don't."""
+    import jax.numpy as jnp
+
+    lr = 0.1
+    opt = mx.optimizer.create(
+        "adamw", lr=lr, weight_decay=0.5, rescale_grad=1.0,
+        decay_filter=lambda name: "bias" not in name)
+    params = {"fc_weight": jnp.ones((3,)), "fc_bias": jnp.ones((3,))}
+    grads = {"fc_weight": jnp.full((3,), 0.1),
+             "fc_bias": jnp.full((3,), 0.1)}
+    states = opt.init_state_tree(params)
+    new_p, _ = opt.apply(params, grads, states, lr)
+
+    ref = mx.optimizer.create("adam", lr=lr, rescale_grad=1.0)
+    rp, _ = ref.apply(params, grads, ref.init_state_tree(params), lr)
+    # bias exempt: identical to Adam; weight decayed: differs by lr*wd*w
+    np.testing.assert_allclose(np.asarray(new_p["fc_bias"]),
+                               np.asarray(rp["fc_bias"]), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(new_p["fc_weight"]),
+        np.asarray(rp["fc_weight"]) - lr * 0.5 * 1.0, atol=1e-6)
