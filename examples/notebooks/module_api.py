@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Module API walkthrough (notebook-style; successor-API counterpart of
+simple_bind.py — the BASELINE north star's module.fit()).
+
+Three levels of control over one model, all the same machinery:
+
+1. high:   mod.fit(train_iter)
+2. middle: bind / init_params / init_optimizer + forward/backward/update
+3. low:    simple_bind executors (see simple_bind.py)
+
+  python examples/notebooks/module_api.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+
+
+def dataset(n=512, dim=16, seed=7):
+    rng = np.random.RandomState(seed)
+    X = np.concatenate([rng.randn(n // 2, dim) + 1.0,
+                        rng.randn(n // 2, dim) - 1.0]).astype(np.float32)
+    y = np.concatenate([np.ones(n // 2), np.zeros(n // 2)]).astype(np.float32)
+    p = rng.permutation(n)
+    return X[p], y[p]
+
+
+def net():
+    s = mx.symbol.Variable("data")
+    s = mx.symbol.FullyConnected(data=s, num_hidden=32, name="fc1")
+    s = mx.symbol.Activation(data=s, act_type="relu", name="relu1")
+    s = mx.symbol.FullyConnected(data=s, num_hidden=2, name="fc2")
+    return mx.symbol.SoftmaxOutput(data=s, name="softmax")
+
+
+def main():
+    X, y = dataset()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(X, y, batch_size=32)
+
+    # ---- level 1: one call --------------------------------------------------
+    mod = mx.mod.Module(net())
+    mod.fit(train, eval_data=val, num_epoch=4,
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1 / 32.0})
+    name, acc = mod.score(val)
+    print(f"fit(): {name}={acc:.3f}")
+    assert acc > 0.95
+
+    # ---- level 2: explicit lifecycle ---------------------------------------
+    mod2 = mx.mod.Module(net())
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label)
+    mod2.init_params(mx.init.Xavier())
+    mod2.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9,
+                                          "rescale_grad": 1 / 32.0})
+    metric = mx.metric.create("accuracy")
+    for epoch in range(4):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            mod2.forward(batch, is_train=True)   # you own the step
+            mod2.backward()
+            mod2.update()
+            mod2.update_metric(metric, batch.label,
+                               pad=getattr(batch, "pad", 0))
+        print(f"epoch {epoch}: train {metric.get()[1]:.3f}")
+    assert metric.get()[1] > 0.95
+
+    # ---- checkpoints interoperate with FeedForward --------------------------
+    import tempfile
+
+    prefix = os.path.join(tempfile.mkdtemp(), "mod")
+    mod.save_checkpoint(prefix, 4)
+    ff = mx.model.FeedForward.load(prefix, 4)
+    agree = (ff.predict(X).argmax(1) == mod.predict(val).argmax(1)).mean()
+    print(f"FeedForward.load on the Module checkpoint agrees: {agree:.3f}")
+    assert agree > 0.99
+
+
+if __name__ == "__main__":
+    main()

@@ -44,3 +44,8 @@ def test_train_mnist_module_api():
         env=dict(os.environ, JAX_PLATFORMS="cpu"))
     assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
     assert "final val accuracy: 1.0" in r.stdout, r.stdout[-500:]
+
+
+@pytest.mark.slow
+def test_module_api_notebook():
+    _run("notebooks/module_api.py")
