@@ -174,3 +174,19 @@ def test_bucketing_module_trains_shared_weights():
                                                 init_states=init_states))
     # the cycle rule t -> t%7+1 is deterministic: well above chance
     assert acc > 0.5, acc
+
+
+def test_module_fit_with_do_checkpoint_callback(tmp_path):
+    """mx.callback.do_checkpoint plugs into Module.fit's epoch_end hook
+    unchanged (same (epoch, symbol, args, aux) signature as FeedForward)."""
+    X, y = _dataset(seed=13)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    prefix = str(tmp_path / "cb")
+    mod = mx.mod.Module(_mlp())
+    mod.fit(it, num_epoch=2, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1 / 32.0},
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    # both epochs checkpointed in FeedForward's container format
+    ff = mx.model.FeedForward.load(prefix, 2)
+    assert (ff.predict(X).argmax(1) == y).mean() > 0.9
