@@ -182,6 +182,15 @@ class Module:
         self._exec.backward()
         return self
 
+    def install_monitor(self, mon):
+        """Attach a Monitor to the bound executor (reference Module
+        surface; drive it with mon.tic() before forward and
+        mon.toc_print() after)."""
+        if not self.binded:
+            raise MXNetError("install_monitor requires bind() first")
+        mon.install(self._exec)
+        return self
+
     def update(self, kvstore=None):
         """Apply one optimizer step to every bound parameter from its
         gradient buffer (updater contract: optimizer.py get_updater).

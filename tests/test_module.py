@@ -190,3 +190,24 @@ def test_module_fit_with_do_checkpoint_callback(tmp_path):
     # both epochs checkpointed in FeedForward's container format
     ff = mx.model.FeedForward.load(prefix, 2)
     assert (ff.predict(X).argmax(1) == y).mean() > 0.9
+
+
+def test_module_install_monitor():
+    """Monitor attaches to the bound executor and reports per-batch
+    internal stats through tic/toc, like the reference Module surface."""
+    from mxnet_tpu.monitor import Monitor
+
+    X, y = _dataset(seed=17)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mon = Monitor(interval=1, pattern=".*fc1.*")
+    mod.install_monitor(mon)
+    it.reset()
+    batch = next(iter(it))
+    mon.tic()
+    mod.forward(batch, is_train=False)
+    stats = mon.toc()
+    assert stats and all("fc1" in name for _, name, _ in stats)
+    assert all(np.isfinite(s) for _, _, s in stats)
