@@ -25,7 +25,7 @@ REFERENCE_SURFACE = {
     "model": ["save_checkpoint", "load_checkpoint", "FeedForward"],
     # extension beyond the v0.5 reference: the successor's Module API
     # (BASELINE north star names module.fit())
-    "mod": ["Module"],
+    "mod": ["Module", "BucketingModule"],
     "name": ["NameManager", "Prefix"],
     "nd": ["NDArray", "onehot_encode", "empty", "zeros", "ones", "array",
            "load", "save"],
