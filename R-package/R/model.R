@@ -29,7 +29,7 @@ mx.model.save <- function(model, prefix, iteration) {
   names <- character(0)
   for (i in seq_along(model$arg_names)) {
     nm <- model$arg_names[i]
-    if (nm == "data" || grepl("label", nm)) next
+    if (nm == "data" || grepl("(^|_)label$", nm)) next
     ids <- c(ids, model$args[i])
     names <- c(names, paste0("arg:", nm))
   }
@@ -145,7 +145,7 @@ mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
     shp <- shapes$arg_shapes[[i]]
     nm <- arg_names[i]
     args[i] <- .mxr.nd.from.host(shp, mx.init.param(initializer, nm, shp))
-    if (nm == "data" || grepl("label", nm)) {
+    if (nm == "data" || grepl("(^|_)label$", nm)) {
       grads[i] <- 0L
       reqs[i] <- 0L
     } else {
@@ -168,7 +168,7 @@ mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
 
   ex <- mx.executor.bind(symbol, args, grads, reqs, auxs)
   data_idx <- which(arg_names == "data")
-  label_idx <- which(grepl("label", arg_names))
+  label_idx <- which(grepl("(^|_)label$", arg_names))
 
   # with a kvstore the pulled gradient is the SUM across workers, so the
   # rescale folds in num_workers — same semantics as the Python layer

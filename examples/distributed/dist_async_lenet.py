@@ -10,6 +10,8 @@ Completes the multi-node matrix: {sync, async} x {mlp, lenet}. The async
 conv tier exercises what the sync one cannot — conv/pool gradients flowing
 through the pickled-tensor wire to the update-on-arrival host (reference:
 kvstore_dist_server.h:194-202) rather than through an in-jit collective.
+The worker body lives in lenet_dist_common.run_tier (shared with the sync
+tier; only kv type and lr differ).
 """
 
 import os
@@ -21,27 +23,7 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-import mxnet_tpu as mx
-from lenet_dist_common import make_dataset
-from mxnet_tpu.models import lenet
-
-
-def main():
-    kv = mx.kv.create("dist_async")
-    rank, nworker = kv.rank, kv.num_workers
-    X, y = make_dataset()
-    Xs, ys = X[rank::nworker], y[rank::nworker]
-
-    model = mx.model.FeedForward(
-        symbol=lenet(num_classes=4), num_epoch=6,
-        learning_rate=0.05, momentum=0.9, initializer=mx.init.Xavier())
-    model.fit(Xs, ys, batch_size=32, kvstore=kv)
-
-    acc = model.score(X, y=y)
-    print(f"worker {rank}/{nworker}: dist_async_lenet accuracy = {acc:.4f}")
-    assert acc > 0.9, f"worker {rank}: accuracy too low: {acc}"
-    kv.barrier()
-
+from lenet_dist_common import run_tier
 
 if __name__ == "__main__":
-    main()
+    run_tier("dist_async", lr=0.05, tag="dist_async_lenet")

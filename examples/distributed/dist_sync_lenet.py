@@ -1,17 +1,16 @@
 #!/usr/bin/env python
 """Distributed data-parallel CONV-NET training to asserted accuracy
-(reference: tests/python/multi-node/dist_sync_lenet.py — LeNet on MNIST
-across launched workers, BSP gradient sync every batch; common.py:2-4 fixes
+(reference: tests/python/multi-node/dist_sync_lenet.py — LeNet across
+launched workers, BSP gradient sync every batch; common.py:2-4 fixes
 randomness so every run converges identically).
 
 Run under the launcher:
     python tools/launch.py -n 2 python examples/distributed/dist_sync_lenet.py
 
-Against dist_sync_mlp.py this tier adds what the judge's round-4 review
-asked for: the *convolutional* stack (conv/pool/BN-free LeNet, the same
-symbol family the reference trains) through the multi-process mesh path —
-conv gradients and the im2col-shaped XLA programs are sharded and synced,
-not just dense matmuls.
+Against dist_sync_mlp.py this tier adds the *convolutional* stack through
+the multi-process mesh path — conv gradients and the im2col-shaped XLA
+programs are sharded and synced, not just dense matmuls. The worker body
+lives in lenet_dist_common.run_tier (shared with the async tier).
 """
 
 import os
@@ -23,27 +22,7 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-import mxnet_tpu as mx
-from lenet_dist_common import make_dataset
-from mxnet_tpu.models import lenet
-
-
-def main():
-    kv = mx.kv.create("dist_sync")
-    rank, nworker = kv.rank, kv.num_workers
-    X, y = make_dataset()
-    Xs, ys = X[rank::nworker], y[rank::nworker]
-
-    model = mx.model.FeedForward(
-        symbol=lenet(num_classes=4), num_epoch=6,
-        learning_rate=0.1, momentum=0.9, initializer=mx.init.Xavier())
-    model.fit(Xs, ys, batch_size=32, kvstore=kv)
-
-    acc = model.score(X, y=y)
-    print(f"worker {rank}/{nworker}: dist_sync_lenet accuracy = {acc:.4f}")
-    assert acc > 0.9, f"worker {rank}: accuracy too low: {acc}"
-    kv.barrier()
-
+from lenet_dist_common import run_tier
 
 if __name__ == "__main__":
-    main()
+    run_tier("dist_sync", lr=0.1, tag="dist_sync_lenet")

@@ -1,7 +1,7 @@
-"""Shared dataset for the multi-node LeNet tiers (reference:
-tests/python/multi-node/common.py — one deterministic dataset module the
-sync and async conv-net scripts both import, randomness fixed so every
-worker and every run sees identical data)."""
+"""Shared dataset + runner for the multi-node LeNet tiers (reference:
+tests/python/multi-node/common.py — one module the sync and async
+conv-net scripts both import, randomness fixed so every worker and every
+run sees identical data)."""
 
 import numpy as np
 
@@ -17,3 +17,25 @@ def make_dataset(n=512, seed=42):
         r, c = corners[int(y[i])]
         X[i, 0, r:r + 10, c:c + 10] += 1.0
     return X, y
+
+
+def run_tier(kv_type, lr, tag, threshold=0.9):
+    """The whole launched-worker body both tiers share: create the store,
+    shard rows by rank, train LeNet, score on the FULL set, assert."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import lenet
+
+    kv = mx.kv.create(kv_type)
+    rank, nworker = kv.rank, kv.num_workers
+    X, y = make_dataset()
+    Xs, ys = X[rank::nworker], y[rank::nworker]
+
+    model = mx.model.FeedForward(
+        symbol=lenet(num_classes=4), num_epoch=6,
+        learning_rate=lr, momentum=0.9, initializer=mx.init.Xavier())
+    model.fit(Xs, ys, batch_size=32, kvstore=kv)
+
+    acc = model.score(X, y=y)
+    print(f"worker {rank}/{nworker}: {tag} accuracy = {acc:.4f}")
+    assert acc > threshold, f"worker {rank}: accuracy too low: {acc}"
+    kv.barrier()

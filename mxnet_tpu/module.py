@@ -45,7 +45,7 @@ from . import optimizer as opt_mod
 from .base import MXNetError
 from .callback import BatchEndParam
 from .context import cpu
-from .model import load_checkpoint, save_checkpoint
+from .model import _as_list, load_checkpoint, save_checkpoint
 
 
 class Module:
@@ -123,21 +123,23 @@ class Module:
         # explicit param dict, a missing entry is an ERROR unless
         # allow_missing=True, in which case the initializer fills it; with
         # no dict at all, everything initializes.
+        # explicit None checks: an EMPTY dict is still an explicit dict
+        # (set_params(args, {}) must preserve aux, not rng-clobber it)
         for name, arr in self._exec.arg_dict.items():
             if name in self._shapes:
                 continue
-            if arg_params and name in arg_params:
+            if arg_params is not None and name in arg_params:
                 arg_params[name].copyto(arr)
-            elif arg_params and not allow_missing:
+            elif arg_params is not None and not allow_missing:
                 raise MXNetError(
                     f"init_params: {name!r} missing from arg_params "
                     "(pass allow_missing=True to initialize it)")
             else:
                 initializer(name, arr)
         for name, arr in self._exec.aux_dict.items():
-            if aux_params and name in aux_params:
+            if aux_params is not None and name in aux_params:
                 aux_params[name].copyto(arr)
-            elif aux_params:
+            elif aux_params is not None:
                 # absent aux states keep their current values (e.g. BN
                 # running stats from a restore) — never rng-clobbered
                 continue
@@ -158,6 +160,9 @@ class Module:
         self._updater = opt_mod.get_updater(optimizer)
         self._param_names = [n for n in self._symbol.list_arguments()
                              if n not in self._shapes]
+        # index -> name mapping for name-aware optimizers (AdamW
+        # decay_filter on the imperative path)
+        optimizer.arg_names = list(self._param_names)
         self.optimizer_initialized = True
         return self
 
@@ -185,9 +190,11 @@ class Module:
     def install_monitor(self, mon):
         """Attach a Monitor to the bound executor (reference Module
         surface; drive it with mon.tic() before forward and
-        mon.toc_print() after)."""
+        mon.toc_print() after). BucketingModule re-installs it on
+        whichever bucket executor each forward selects."""
         if not self.binded:
             raise MXNetError("install_monitor requires bind() first")
+        self._monitor = mon
         mon.install(self._exec)
         return self
 
@@ -207,7 +214,9 @@ class Module:
             raise MXNetError("update requires init_optimizer() first")
         if kvstore is not None and (
                 getattr(kvstore, "type", "") == "dist_async"
-                or getattr(kvstore, "_updater", None) is not None):
+                or getattr(kvstore, "_updater", None) is not None
+                or getattr(getattr(kvstore, "_server", None), "updater",
+                           None) is not None):
             raise MXNetError(
                 "Module.update routes gradients through the store "
                 "(update-on-worker); this kvstore runs an updater on the "
@@ -319,8 +328,10 @@ class Module:
                                    pad=getattr(batch, "pad", 0))
                 nbatch += 1
                 if batch_end_callback is not None:
-                    batch_end_callback(BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric))
+                    p = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                      eval_metric=eval_metric)
+                    for cb in _as_list(batch_end_callback):
+                        cb(p)
             name, value = eval_metric.get()
             self._logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
             self._logger.info("Epoch[%d] Time cost=%.3f", epoch,
@@ -331,7 +342,8 @@ class Module:
                                   value)
             if epoch_end_callback is not None:
                 arg, aux = self.get_params()
-                epoch_end_callback(epoch, self._symbol, arg, aux)
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self._symbol, arg, aux)
         return self
 
     def score(self, eval_data, eval_metric="accuracy"):
@@ -447,6 +459,9 @@ class BucketingModule(Module):
                               [tuple(a.shape) for a in labels]))
             self._bucket_execs[key] = self._executor_for(key, shapes)
         self._exec = self._bucket_execs[key]
+        mon = getattr(self, "_monitor", None)
+        if mon is not None:
+            mon.install(self._exec)  # stats must read THIS bucket's step
         if is_train is None:
             is_train = self.for_training
         feed = {}

@@ -199,8 +199,25 @@ class AdamW(Adam):
         # decay_filter(name) -> bool: False exempts a parameter (the
         # standard recipe exempts biases/LayerNorm/embeddings). None
         # decays everything. Name-aware masking rides the pytree path's
-        # per-name loop (Optimizer.apply), so it is trace-time static.
+        # per-name loop (apply) and the imperative path's index->name
+        # mapping (update, via arg_names) — both trace-time static.
         self.decay_filter = decay_filter
+
+    def update(self, index, weight, grad, state):
+        if self.decay_filter is None:
+            return super().update(index, weight, grad, state)
+        if not self.arg_names or not 0 <= index < len(self.arg_names):
+            raise MXNetError(
+                "AdamW.decay_filter needs parameter NAMES on the "
+                "imperative path: set optimizer.arg_names (FeedForward and "
+                "Module do this automatically) or drop the filter")
+        wd = self.weight_decay
+        try:
+            if not self.decay_filter(self.arg_names[index]):
+                self.weight_decay = 0.0
+            return super().update(index, weight, grad, state)
+        finally:
+            self.weight_decay = wd
 
     def apply(self, params, grads, states, lr):
         if self.decay_filter is None:

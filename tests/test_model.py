@@ -394,3 +394,34 @@ def test_adamw_decay_filter_exempts_parameters():
     np.testing.assert_allclose(
         np.asarray(new_p["fc_weight"]),
         np.asarray(rp["fc_weight"]) - lr * 0.5 * 1.0, atol=1e-6)
+
+
+def test_adamw_decay_filter_imperative_path():
+    """The filter must also mask on the update()/get_updater path (Module
+    / kvstore training), via the optimizer's index->name mapping."""
+    lr = 0.1
+    opt = mx.optimizer.create(
+        "adamw", lr=lr, weight_decay=0.5, rescale_grad=1.0,
+        decay_filter=lambda name: "bias" not in name)
+    opt.arg_names = ["fc_weight", "fc_bias"]
+    ref = mx.optimizer.create("adam", lr=lr, rescale_grad=1.0)
+
+    g = np.full(3, 0.1, np.float32)
+    w_dec = mx.nd.array(np.ones(3, np.float32))   # index 0: decayed
+    w_ex = mx.nd.array(np.ones(3, np.float32))    # index 1: exempt
+    w_ref = mx.nd.array(np.ones(3, np.float32))
+    opt.update(0, w_dec, mx.nd.array(g), opt.create_state(0, w_dec))
+    opt.update(1, w_ex, mx.nd.array(g), opt.create_state(1, w_ex))
+    ref.update(0, w_ref, mx.nd.array(g), ref.create_state(0, w_ref))
+
+    np.testing.assert_allclose(w_ex.asnumpy(), w_ref.asnumpy(), atol=1e-6)
+    np.testing.assert_allclose(w_dec.asnumpy(),
+                               w_ref.asnumpy() - lr * 0.5 * 1.0, atol=1e-6)
+
+    # without names the filter cannot be honored: loud, not silent
+    opt2 = mx.optimizer.create("adamw", decay_filter=lambda n: True)
+    try:
+        opt2.update(0, w_ex, mx.nd.array(g), opt2.create_state(0, w_ex))
+        raise AssertionError("expected MXNetError without arg_names")
+    except mx.base.MXNetError:
+        pass

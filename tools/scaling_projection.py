@@ -46,20 +46,21 @@ OVERLAP = 0.7          # fraction of all-reduce hidden under backward
 def allreduce_bytes_from_hlo(n_dev=8):
     """Compile the dp ResNet-50 train step over an n_dev virtual mesh and
     sum the all-reduce payload bytes from the optimized HLO."""
+    # strip any pre-set device-count token and append ours: this tool's
+    # mesh needs exactly n_dev virtual CPU devices
     flag = f"--xla_force_host_platform_device_count={n_dev}"
-    if "xla_force_host_platform_device_count" not in \
-            os.environ.get("XLA_FLAGS", ""):
-        # append, never setdefault: a pre-set XLA_FLAGS without the device
-        # count would otherwise leave one CPU device and break the mesh
-        os.environ["XLA_FLAGS"] = \
-            (os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    kept = [t for t in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in t]
+    os.environ["XLA_FLAGS"] = " ".join(kept + [flag])
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+    # ALWAYS the cpu platform: the projection is a compile-only analysis
+    # over a virtual mesh — initializing the (wedge-prone) TPU tunnel here
+    # would both hang the tool and yield a 1-device mesh
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
     import jax.numpy as jnp
 
     from mxnet_tpu.executor import _build_graph_fn
