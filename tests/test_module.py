@@ -211,3 +211,36 @@ def test_module_install_monitor():
     stats = mon.toc()
     assert stats and all("fc1" in name for _, name, _ in stats)
     assert all(np.isfinite(s) for _, _, s in stats)
+
+
+def test_module_with_imagerecorditer(tmp_path):
+    """Module.fit over the RecordIO pipeline iterator (DataIter protocol
+    integration: provide_data/label shapes, pad handling, conv net)."""
+    from mxnet_tpu import recordio as rio
+    from mxnet_tpu.models import lenet
+
+    rec = str(tmp_path / "d.rec")
+    rng = np.random.RandomState(0)
+    w = rio.MXRecordIO(rec, "w")
+    for i in range(192):
+        cls = i % 2
+        img = rng.randint(0, 60, (32, 32, 3), np.uint8)
+        if cls:
+            img[8:24, 8:24] = 220
+        w.write(rio.pack_img(rio.IRHeader(0, float(cls), i, 0), img,
+                             img_fmt=".jpg", quality=92))
+    w.close()
+
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 28, 28),
+                               batch_size=32, rand_crop=True, shuffle=True,
+                               mean_r=60.0, mean_g=60.0, mean_b=60.0,
+                               scale=1 / 255.0)
+    mod = mx.mod.Module(lenet(num_classes=2),
+                        data_names=tuple(n for n, _ in it.provide_data))
+    mod.fit(it, num_epoch=8, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                              "rescale_grad": 1 / 32.0})
+    _, acc = mod.score(mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 28, 28), batch_size=32,
+        mean_r=60.0, mean_g=60.0, mean_b=60.0, scale=1 / 255.0))
+    assert acc > 0.9, acc
