@@ -244,3 +244,27 @@ def test_module_with_imagerecorditer(tmp_path):
         path_imgrec=rec, data_shape=(3, 28, 28), batch_size=32,
         mean_r=60.0, mean_g=60.0, mean_b=60.0, scale=1 / 255.0))
     assert acc > 0.9, acc
+
+
+def test_module_inference_only_bind():
+    """bind(for_training=False): no gradient buffers anywhere, forward
+    works, update is refused (optimizer lifecycle never ran)."""
+    X, y = _dataset(seed=19)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    train = mx.mod.Module(_mlp())
+    train.fit(it, num_epoch=4, initializer=mx.init.Xavier(),
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                "rescale_grad": 1 / 32.0})
+    arg, aux = train.get_params()
+
+    infer = mx.mod.Module(_mlp())
+    infer.bind(data_shapes=it.provide_data, for_training=False)
+    infer.init_params(arg_params=arg, aux_params=aux)
+    assert not any(infer._exec.grad_dict.values())
+    preds = infer.predict(mx.io.NDArrayIter(X, y, batch_size=32))
+    assert (preds.argmax(1) == y).mean() > 0.95
+    try:
+        infer.update()
+        raise AssertionError("expected MXNetError")
+    except mx.base.MXNetError:
+        pass
