@@ -268,3 +268,33 @@ def test_module_inference_only_bind():
         raise AssertionError("expected MXNetError")
     except mx.base.MXNetError:
         pass
+
+
+def test_module_update_rejects_server_side_updater_stores():
+    """Stores whose updater runs server-side (group set_optimizer, or any
+    store after set_updater) must be refused by Module.update: their pull
+    returns weights, which this path would mis-apply as gradients."""
+    from mxnet_tpu.kvstore import create_group
+
+    X, y = _dataset(seed=23)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer()
+
+    kv = create_group(1)[0]
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    try:
+        mod.update(kvstore=kv)
+        raise AssertionError("expected MXNetError for group server updater")
+    except mx.base.MXNetError as e:
+        assert "update-on-kvstore" in str(e)
+
+    kv2 = mx.kv.create("local")
+    kv2.set_updater(lambda k, g, w: None)
+    try:
+        mod.update(kvstore=kv2)
+        raise AssertionError("expected MXNetError for local set_updater")
+    except mx.base.MXNetError as e:
+        assert "update-on-kvstore" in str(e)
