@@ -1,0 +1,40 @@
+# Device contexts for the R binding (reference capability:
+# R-package/R/context.R — mx.cpu / mx.gpu / mx.ctx.default). The runtime's
+# accelerator slot is the TPU, so mx.tpu() is the native device and
+# mx.gpu() aliases it for script compatibility (same mapping as the C API:
+# dev_type 2 -> tpu, capi_support.py _ctx).
+#
+# Contexts are descriptors consumed at ndarray/executor creation; with one
+# XLA backend per process the descriptor mainly records intent (device
+# type + id), which keeps reference training scripts portable.
+
+mx.ctx.new <- function(device, device.id = 0L) {
+  structure(list(device = device, device_id = as.integer(device.id)),
+            class = "MXContext")
+}
+
+mx.cpu <- function(dev.id = 0L) mx.ctx.new("cpu", dev.id)
+
+mx.tpu <- function(dev.id = 0L) mx.ctx.new("tpu", dev.id)
+
+# accelerator alias: reference scripts say mx.gpu(); the runtime's
+# accelerator is the TPU
+mx.gpu <- function(dev.id = 0L) mx.ctx.new("tpu", dev.id)
+
+is.mx.context <- function(x) inherits(x, "MXContext")
+
+# package-default context; mx.ctx.default(new) sets, mx.ctx.default() gets
+.mxr.ctx.env <- new.env()
+
+mx.ctx.default <- function(new = NULL) {
+  if (!is.null(new)) {
+    stopifnot(is.mx.context(new))
+    .mxr.ctx.env$default <- new
+  }
+  if (is.null(.mxr.ctx.env$default)) .mxr.ctx.env$default <- mx.tpu()
+  .mxr.ctx.env$default
+}
+
+print.MXContext <- function(x, ...) {
+  cat(sprintf("mx.ctx(%s:%d)\n", x$device, x$device_id))
+}

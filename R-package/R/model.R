@@ -25,24 +25,18 @@
 mx.model.save <- function(model, prefix, iteration) {
   json <- mx.symbol.tojson(model$symbol)
   writeLines(json, paste0(prefix, "-symbol.json"))
-  ids <- integer(0)
-  names <- character(0)
+  nds <- list()
   for (i in seq_along(model$arg_names)) {
     nm <- model$arg_names[i]
     if (nm == "data" || grepl("(^|_)label$", nm)) next
-    ids <- c(ids, model$args[i])
-    names <- c(names, paste0("arg:", nm))
+    nds[[paste0("arg:", nm)]] <- model$args[i]
   }
   if (!is.null(model$aux_names) && length(model$aux_names) > 0) {
     for (i in seq_along(model$aux_names)) {
-      ids <- c(ids, model$auxs[i])
-      names <- c(names, paste0("aux:", model$aux_names[i]))
+      nds[[paste0("aux:", model$aux_names[i])]] <- model$auxs[i]
     }
   }
-  fname <- sprintf("%s-%04d.params", prefix, iteration)
-  invisible(.mxr.status(.C("mxr_nd_save", as.character(fname),
-                           as.integer(length(ids)), as.integer(ids),
-                           as.character(names), status = integer(1))))
+  mx.nd.save(nds, sprintf("%s-%04d.params", prefix, iteration))
 }
 
 # returns list(symbol, arg_params, aux_params) — named ndarray-id lists;
@@ -51,22 +45,14 @@ mx.model.save <- function(model, prefix, iteration) {
 mx.model.load <- function(prefix, iteration) {
   json <- paste(readLines(paste0(prefix, "-symbol.json")), collapse = "\n")
   symbol <- mx.symbol.fromjson(json)
-  fname <- sprintf("%s-%04d.params", prefix, iteration)
-  buf <- paste(rep(" ", 65536L), collapse = "")
-  r <- .mxr.status(.C("mxr_nd_load", as.character(fname), 1024L,
-                      n = integer(1), ids = integer(1024),
-                      names = as.character(buf), as.integer(65536L),
-                      status = integer(1)))
-  names <- strsplit(r$names, "\n")[[1]]
-  ids <- r$ids[seq_len(r$n)]
+  loaded <- mx.nd.load(sprintf("%s-%04d.params", prefix, iteration))
   arg_params <- list()
   aux_params <- list()
-  for (i in seq_len(r$n)) {
-    nm <- names[i]
-    if (startsWith(nm, "arg:")) {
-      arg_params[[substring(nm, 5)]] <- ids[i]
-    } else if (startsWith(nm, "aux:")) {
-      aux_params[[substring(nm, 5)]] <- ids[i]
+  for (nm in names(loaded)) {
+    if (mx.util.str.startswith(nm, "arg:")) {
+      arg_params[[substring(nm, 5)]] <- loaded[[nm]]
+    } else if (mx.util.str.startswith(nm, "aux:")) {
+      aux_params[[substring(nm, 5)]] <- loaded[[nm]]
     }
   }
   list(symbol = symbol, arg_params = arg_params, aux_params = aux_params)

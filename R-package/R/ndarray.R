@@ -49,3 +49,111 @@ mx.nd.set <- function(h, data) {
 mx.nd.free <- function(h) {
   invisible(.C("mxr_nd_free", as.integer(h), status = integer(1)))
 }
+
+# ---------------------------------------------------- ndarray math surface
+# Reference capability: R-package/R/ndarray.R's arithmetic layer (Ops
+# dispatch onto the registered NDArray functions). Everything below runs
+# inside the runtime via mxr_func_invoke (MXFuncInvoke -> XLA); R holds
+# only integer handles. Non-mutating: each op allocates its result
+# ndarray, so R expressions compose like plain arrays (`(a + b) / c`).
+
+# fresh runtime ndarray with the given dims (runtime dims == logical R
+# dims, the mx.nd.array convention) — no host fill and no zeroing; callers
+# overwrite it via a registered function
+.mxr.nd.alloc <- function(shape) {
+  r <- .mxr.status(.C("mxr_nd_create", as.integer(shape),
+                      as.integer(length(shape)), id = integer(1),
+                      status = integer(1)))
+  structure(r$id, class = "mxtpu.ndarray", dims = as.integer(shape))
+}
+
+.mxr.nd.binop <- function(fname, a, b) {
+  out <- .mxr.nd.alloc(mx.nd.shape(a))
+  .mxr.func(fname, c(a, b), numeric(0), out)
+  out
+}
+
+.mxr.nd.scalar.op <- function(fname, a, s) {
+  out <- .mxr.nd.alloc(mx.nd.shape(a))
+  .mxr.func(fname, a, s, out)
+  out
+}
+
+# +, -, *, / on mxtpu.ndarray, mixed with R numerics: the scalar side maps
+# onto the _*_scalar registered variants (including the reversed-operand
+# _rminus/_rdiv forms, reference ndarray.cc's scalar family).
+Ops.mxtpu.ndarray <- function(e1, e2) {
+  op <- .Generic
+  if (!op %in% c("+", "-", "*", "/"))
+    stop("mxtpu.ndarray does not support ", op)
+  if (missing(e2)) {  # unary +x / -x
+    if (op == "+") return(e1)
+    return(.mxr.nd.scalar.op("_mul_scalar", e1, -1))
+  }
+  a.nd <- inherits(e1, "mxtpu.ndarray")
+  b.nd <- inherits(e2, "mxtpu.ndarray")
+  if (a.nd && b.nd) {
+    fname <- c(`+` = "_plus", `-` = "_minus",
+               `*` = "_mul", `/` = "_div")[[op]]
+    return(.mxr.nd.binop(fname, e1, e2))
+  }
+  if (a.nd) {
+    fname <- c(`+` = "_plus_scalar", `-` = "_minus_scalar",
+               `*` = "_mul_scalar", `/` = "_div_scalar")[[op]]
+    return(.mxr.nd.scalar.op(fname, e1, as.double(e2)))
+  }
+  # scalar op ndarray: + and * commute; - and / use the reversed forms
+  fname <- c(`+` = "_plus_scalar", `-` = "_rminus_scalar",
+             `*` = "_mul_scalar", `/` = "_rdiv_scalar")[[op]]
+  .mxr.nd.scalar.op(fname, e2, as.double(e1))
+}
+
+# The shape-preserving math surface (mx.nd.square/sqrt/exp/log/clip and
+# the scalar forms) lives in mxtpu_generated.R: the generator emits those
+# wrappers with an optional `out` that allocates via .mxr.nd.alloc. Only
+# functions whose OUTPUT shape differs from the first operand's are
+# hand-authored here (the generator can't know per-op shape rules).
+
+# L2 norm reduces to one element
+mx.nd.norm <- function(a, out = NULL) {
+  if (is.null(out)) out <- .mxr.nd.alloc(1L)
+  .mxr.func("norm", a, numeric(0), out)
+  out
+}
+
+# matrix product of 2-d ndarrays: out dims follow (m,k)x(k,n)
+mx.nd.dot <- function(a, b, out = NULL) {
+  sa <- mx.nd.shape(a)
+  sb <- mx.nd.shape(b)
+  stopifnot(length(sa) == 2, length(sb) == 2, sa[2] == sb[1])
+  if (is.null(out)) out <- .mxr.nd.alloc(c(sa[1], sb[2]))
+  .mxr.func("dot", c(a, b), numeric(0), out)
+  out
+}
+
+# ------------------------------------------------- ndarray save/load (user)
+# Container-format parity with the Python/C sides (mxr_nd_save/load wrap
+# the same writer MXNDArraySave uses), so R-written files load from
+# Python's nd.load and vice versa. `nds` is a NAMED list of handles.
+mx.nd.save <- function(nds, fname) {
+  stopifnot(length(names(nds)) == length(nds))
+  invisible(.mxr.status(.C("mxr_nd_save", as.character(fname),
+                           as.integer(length(nds)),
+                           as.integer(unlist(nds)),
+                           as.character(names(nds)),
+                           status = integer(1))))
+}
+
+mx.nd.load <- function(fname, max_n = 1024L, name_cap = 65536L) {
+  buf <- paste(rep(" ", name_cap), collapse = "")
+  r <- .mxr.status(.C("mxr_nd_load", as.character(fname),
+                      as.integer(max_n), n = integer(1),
+                      ids = integer(max_n), names = as.character(buf),
+                      as.integer(name_cap), status = integer(1)))
+  names <- strsplit(r$names, "\n")[[1]]
+  out <- list()
+  for (i in seq_len(r$n)) {
+    out[[names[i]]] <- structure(r$ids[i], class = "mxtpu.ndarray")
+  }
+  out
+}

@@ -93,7 +93,7 @@ mx.nd.zeros.like <- function(h) {
   # runtime-side fill (_set_value) — no prod(shape) host doubles crossing
   # the .C boundary just to zero device memory
   .mxr.func("_set_value", integer(0), 0, r$id)
-  structure(r$id, class = "mxtpu.ndarray", dims = rev(shp))
+  structure(r$id, class = "mxtpu.ndarray", dims = shp)
 }
 
 mx.opt.create <- function(name, ...) {

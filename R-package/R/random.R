@@ -1,0 +1,33 @@
+# Device random numbers for the R binding (reference capability:
+# R-package/R/random.R — mx.set.seed / mx.runif / mx.rnorm).
+#
+# The samplers run INSIDE the runtime via the registered NDArray functions
+# `_random_uniform` / `_random_gaussian` (capi_support.py _FUNCTIONS;
+# reference ndarray.cc registered sampler pair): R never generates the
+# numbers, it only seeds the framework RNG and reads results back. That is
+# why mx.set.seed exists as its own function — the reference kept device
+# RNG state separate from R's set.seed for exactly this reason, and here
+# the state is the runtime's PRNG key chain (mxnet_tpu/random.py), not R's.
+
+mx.set.seed <- function(seed) {
+  invisible(.mxr.status(.C("mxr_random_seed", as.integer(seed),
+                           status = integer(1))))
+}
+
+# Uniform in [min, max): scalars ride the registered function's scalar
+# slots; the runtime sampler overwrites a freshly allocated ndarray
+# (.mxr.nd.alloc, ndarray.R — runtime dims == logical R dims).
+mx.runif <- function(shape, min = 0, max = 1) {
+  stopifnot(is.numeric(min), is.numeric(max))
+  out <- .mxr.nd.alloc(shape)
+  .mxr.func("_random_uniform", integer(0), c(min, max), out)
+  out
+}
+
+# Normal with mean/sd.
+mx.rnorm <- function(shape, mean = 0, sd = 1) {
+  stopifnot(is.numeric(mean), is.numeric(sd))
+  out <- .mxr.nd.alloc(shape)
+  .mxr.func("_random_gaussian", integer(0), c(mean, sd), out)
+  out
+}

@@ -1,0 +1,20 @@
+# Small shared helpers for the R binding (reference capability:
+# R-package/R/util.R — string predicates and list filtering the user layer
+# leans on).
+
+mx.util.str.endswith <- function(name, suffix) {
+  n <- nchar(name)
+  s <- nchar(suffix)
+  s <= n && substring(name, n - s + 1, n) == suffix
+}
+
+mx.util.str.startswith <- function(name, prefix) {
+  nchar(prefix) <= nchar(name) &&
+    substring(name, 1, nchar(prefix)) == prefix
+}
+
+# drop NULL entries, preserving names (used when assembling optional
+# argument lists for .C calls)
+mx.util.filter.null <- function(lst) {
+  lst[!vapply(lst, is.null, logical(1))]
+}

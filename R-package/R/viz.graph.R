@@ -1,0 +1,140 @@
+# Computation-graph visualization for the R binding (reference capability:
+# R-package/R/viz.graph.R — graph.viz over the symbol's JSON). The
+# reference rendered through an R graph widget; here the renderer-neutral
+# form is Graphviz DOT text, built from the SAME symbol JSON the
+# save/load path uses (symbol.R mx.symbol.tojson), so the picture always
+# reflects what the executor will actually run. Write the string to a
+# .dot file and any graphviz install renders it; tests parse the DOT
+# structurally.
+#
+# The JSON subset the symbol serializer emits (objects, arrays, strings,
+# numbers, booleans) is parsed by the recursive-descent reader below —
+# base R has no JSON parser and the package adds no dependencies.
+
+.mxr.json.parse <- function(text) {
+  env <- new.env()
+  env$s <- text
+  env$i <- 1L
+  env$n <- nchar(text)
+  peek <- function() substring(env$s, env$i, env$i)
+  adv <- function() env$i <- env$i + 1L
+  skip.ws <- function() {
+    while (env$i <= env$n && peek() %in% c(" ", "\n", "\t", "\r")) adv()
+  }
+  read.value <- function() {
+    skip.ws()
+    c0 <- peek()
+    if (c0 == "{") return(read.object())
+    if (c0 == "[") return(read.array())
+    if (c0 == "\"") return(read.string())
+    read.literal()
+  }
+  read.object <- function() {
+    adv()  # consume {
+    out <- list()
+    skip.ws()
+    if (peek() == "}") { adv(); return(out) }
+    repeat {
+      skip.ws()
+      key <- read.string()
+      skip.ws()
+      adv()  # consume :
+      out[[key]] <- read.value()
+      skip.ws()
+      if (peek() == ",") { adv(); next }
+      adv()  # consume }
+      break
+    }
+    out
+  }
+  read.array <- function() {
+    adv()  # consume [
+    out <- list()
+    skip.ws()
+    if (peek() == "]") { adv(); return(out) }
+    repeat {
+      out[[length(out) + 1L]] <- read.value()
+      skip.ws()
+      if (peek() == ",") { adv(); next }
+      adv()  # consume ]
+      break
+    }
+    out
+  }
+  read.string <- function() {
+    adv()  # consume opening quote
+    start <- env$i
+    buf <- character(0)
+    while (peek() != "\"") {
+      if (peek() == "\\") {  # keep escaped char verbatim (names/op strings)
+        buf <- c(buf, substring(env$s, start, env$i - 1L))
+        adv()
+        start <- env$i
+      }
+      adv()
+    }
+    s <- paste0(paste(buf, collapse = ""),
+                substring(env$s, start, env$i - 1L))
+    adv()  # consume closing quote
+    s
+  }
+  read.literal <- function() {
+    start <- env$i
+    while (env$i <= env$n &&
+           grepl("[-+0-9.eEa-z]", peek())) adv()
+    tok <- substring(env$s, start, env$i - 1L)
+    if (tok == "true") return(TRUE)
+    if (tok == "false") return(FALSE)
+    if (tok == "null") return(NULL)
+    as.numeric(tok)
+  }
+  read.value()
+}
+
+# op -> DOT node style, the reference's convention of coloring by role
+# (data/weights plain, compute ops filled by family)
+.mxr.viz.style <- function(op) {
+  if (op == "null")
+    return("shape=ellipse, style=solid")
+  fill <- if (grepl("Convolution|FullyConnected", op)) "#8dd3c7"
+          else if (grepl("Activation|relu|LeakyReLU", op)) "#fb8072"
+          else if (grepl("Pooling", op)) "#80b1d3"
+          else if (grepl("BatchNorm", op)) "#bebada"
+          else if (grepl("Softmax|Output|Loss", op)) "#fdb462"
+          else "#d9d9d9"
+  sprintf("shape=box, style=filled, fillcolor=\"%s\"", fill)
+}
+
+# symbol (or its JSON string) -> Graphviz DOT text. Auxiliary parameter
+# inputs (weights/bias/moving stats) are folded into their consumer's
+# label rather than drawn, matching the reference's hide.weights=TRUE
+# default that keeps real topology readable.
+mx.viz.graph <- function(symbol, hide.weights = TRUE) {
+  json <- if (is.character(symbol)) symbol else mx.symbol.tojson(symbol)
+  g <- .mxr.json.parse(json)
+  nodes <- g$nodes
+  is.param <- vapply(seq_along(nodes), function(i) {
+    nd <- nodes[[i]]
+    nd$op == "null" && nd$name != "data" &&
+      !mx.util.str.endswith(nd$name, "label")
+  }, logical(1))
+  lines <- c("digraph mxtpu {", "  rankdir=BT;")
+  for (i in seq_along(nodes)) {
+    nd <- nodes[[i]]
+    if (hide.weights && is.param[i]) next
+    label <- if (nd$op == "null") nd$name
+             else sprintf("%s\\n%s", nd$op, nd$name)
+    lines <- c(lines, sprintf("  n%d [label=\"%s\", %s];",
+                              i - 1L, label, .mxr.viz.style(nd$op)))
+  }
+  for (i in seq_along(nodes)) {
+    nd <- nodes[[i]]
+    if (hide.weights && is.param[i]) next
+    for (inp in nd$inputs) {
+      src <- inp[[1]] + 1L
+      if (hide.weights && is.param[src]) next
+      lines <- c(lines, sprintf("  n%d -> n%d;", src - 1L, i - 1L))
+    }
+  }
+  paste(c(lines, "}"), collapse = "\n")
+}

@@ -23,6 +23,10 @@ source(file.path("R", "callback.R"))
 source(file.path("R", "io.R"))
 source(file.path("R", "kvstore.R"))
 source(file.path("R", "model.R"))
+source(file.path("R", "util.R"))
+source(file.path("R", "context.R"))
+source(file.path("R", "random.R"))
+source(file.path("R", "viz.graph.R"))
 
 mx.r.seed(0)
 

@@ -291,7 +291,7 @@ void mxr_nd_load(char** fname, int* cap, int* n_out, int* ids_out,
     for (mx_uint i = 0; i < n; ++i) MXNDArrayFree(hs[i]);
     g_last_error = "mxr_nd_load: joined parameter names exceed the "
                    "caller-provided name buffer; raise name_cap in "
-                   "mx.model.load";
+                   "mx.nd.load";
     *status = -1;
     return;
   }

@@ -635,3 +635,119 @@ def test_r_shim_load_bind_predict_sequence(train_shim, tmp_path):
     e = np.exp(logits - logits.max(1, keepdims=True))
     expected = e / e.sum(1, keepdims=True)
     np.testing.assert_allclose(got, expected, atol=2e-4, rtol=1e-3)
+
+
+def _shim_func_invoke(lib):
+    """The exact .C("mxr_func_invoke") call shape every R math wrapper
+    makes (ndarray.R .mxr.func): name, use-var handles, scalars, one
+    mutate handle."""
+    def func(name, use, scalars, mutate):
+        st = _p_int(1)
+        sc = (ctypes.c_double * max(1, len(scalars)))(*scalars)
+        lib.mxr_func_invoke(_p_str(name), _p_int(len(use)),
+                            _p_int(*(use or [0])), _p_int(len(scalars)), sc,
+                            _p_int(1), _p_int(mutate), st)
+        _st(lib, None, st)
+    return func
+
+
+def test_r_shim_random_layer(train_shim):
+    """random.R's device-RNG route: mxr_random_seed + the registered
+    sampler functions mutate runtime arrays (R never generates numbers).
+    Seeding must make the sequence reproducible, like the reference's
+    mx.set.seed contract (R-package/R/random.R examples)."""
+    lib = train_shim
+    nd_create, nd_set, nd_get = _shim_nd_helpers(lib)
+    func = _shim_func_invoke(lib)
+
+    def seed(s):
+        st = _p_int(1)
+        lib.mxr_random_seed(_p_int(s), st)
+        _st(lib, None, st)
+
+    h = nd_create([64])
+    seed(11)
+    func("_random_uniform", [], [0.0, 1.0], h)
+    first = nd_get(h, 64)
+    assert 0.0 <= first.min() and first.max() < 1.0
+    func("_random_uniform", [], [0.0, 1.0], h)
+    second = nd_get(h, 64)
+    assert not np.allclose(first, second)  # stream advances
+    seed(11)
+    func("_random_uniform", [], [0.0, 1.0], h)
+    np.testing.assert_allclose(nd_get(h, 64), first)  # reseed replays
+
+    # gaussian with mean/sd scalars lands in the right distribution
+    hg = nd_create([4096])
+    seed(5)
+    func("_random_gaussian", [], [3.0, 0.5], hg)
+    draw = nd_get(hg, 4096)
+    assert abs(draw.mean() - 3.0) < 0.05
+    assert abs(draw.std() - 0.5) < 0.05
+
+    # bounds ride the scalar slots: uniform in [10, 12)
+    seed(6)
+    func("_random_uniform", [], [10.0, 12.0], h)
+    u = nd_get(h, 64)
+    assert 10.0 <= u.min() and u.max() < 12.0
+
+
+def test_r_shim_ndarray_math_surface(train_shim):
+    """ndarray.R's Ops group generics and math helpers: every call the R
+    layer makes (fresh out ndarray + mxr_func_invoke) verified against
+    numpy, including the reversed scalar forms and the dot/clip/unary
+    registered functions."""
+    lib = train_shim
+    nd_create, nd_set, nd_get = _shim_nd_helpers(lib)
+    func = _shim_func_invoke(lib)
+
+    rng = np.random.RandomState(2)
+    a = rng.rand(3, 4) + 0.5
+    b = rng.rand(3, 4) + 0.5
+    ha, hb, hout = nd_create([3, 4]), nd_create([3, 4]), nd_create([3, 4])
+    nd_set(ha, a)
+    nd_set(hb, b)
+
+    # Ops.mxtpu.ndarray: nd (+,-,*,/) nd — fresh out per expression
+    for fname, ref in [("_plus", a + b), ("_minus", a - b),
+                       ("_mul", a * b), ("_div", a / b)]:
+        func(fname, [ha, hb], [], hout)
+        np.testing.assert_allclose(nd_get(hout, 12).reshape(3, 4), ref,
+                                   rtol=1e-6)
+
+    # scalar forms incl. the reversed ones (scalar - nd, scalar / nd)
+    for fname, sc, ref in [("_plus_scalar", 2.5, a + 2.5),
+                           ("_minus_scalar", 2.5, a - 2.5),
+                           ("_mul_scalar", 2.5, a * 2.5),
+                           ("_div_scalar", 2.5, a / 2.5),
+                           ("_rminus_scalar", 2.5, 2.5 - a),
+                           ("_rdiv_scalar", 2.5, 2.5 / a)]:
+        func(fname, [ha], [sc], hout)
+        np.testing.assert_allclose(nd_get(hout, 12).reshape(3, 4), ref,
+                                   rtol=1e-6)
+
+    # mx.nd.clip's two scalar bounds
+    func("clip", [ha], [0.6, 1.1], hout)
+    np.testing.assert_allclose(nd_get(hout, 12).reshape(3, 4),
+                               np.clip(a, 0.6, 1.1), rtol=1e-6)
+
+    # unary family
+    for fname, ref in [("square", a * a), ("sqrt", np.sqrt(a)),
+                       ("exp", np.exp(a)), ("log", np.log(a))]:
+        func(fname, [ha], [], hout)
+        np.testing.assert_allclose(nd_get(hout, 12).reshape(3, 4), ref,
+                                   rtol=1e-5)
+
+    # mx.nd.norm reduces to one element
+    hn = nd_create([1])
+    func("norm", [ha], [], hn)
+    np.testing.assert_allclose(nd_get(hn, 1)[0], np.linalg.norm(a),
+                               rtol=1e-5)
+
+    # mx.nd.dot shape logic: (3,4) x (4,2) -> (3,2)
+    c = rng.rand(4, 2)
+    hc, hd = nd_create([4, 2]), nd_create([3, 2])
+    nd_set(hc, c)
+    func("dot", [ha, hc], [], hd)
+    np.testing.assert_allclose(nd_get(hd, 6).reshape(3, 2), a @ c,
+                               rtol=1e-5)

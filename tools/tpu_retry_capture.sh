@@ -1,0 +1,53 @@
+#!/bin/bash
+# Persistent retry loop for the round-5 TPU evidence stages. The tunnel
+# wedges and recovers unpredictably (BENCH_NOTES_r05.md §0/§1), so after
+# tpu_capture_all.sh's single pass, keep probing; whenever a probe finds
+# the backend healthy, re-run every stage that has not yet recorded rc=0
+# in TPU_CAPTURE_r05.log. Stages already green are never re-run, so a
+# late healthy window costs only the still-missing evidence.
+set -u
+cd "$(dirname "$0")/.."
+LOG=TPU_CAPTURE_r05.log
+
+stage_done() {  # stage_done <name> -> 0 if the log has "--- <name> done rc=0"
+  grep -q -- "--- $1 done rc=0" "$LOG" 2>/dev/null
+}
+
+probe_ok() {
+  timeout 150 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform == "tpu"
+EOF
+}
+
+run_stage() {
+  local name="$1"; shift
+  echo "--- $name: $* ($(date -u +%T)) [retry-loop]" | tee -a "$LOG"
+  local t0=$SECONDS
+  timeout 2000 "$@" >> "$LOG" 2>&1
+  local rc=$?
+  echo "--- $name done rc=$rc in $((SECONDS-t0))s" | tee -a "$LOG"
+}
+
+# wait for the first-pass capture script to finish so stages never overlap
+while pgrep -f tpu_capture_all.sh >/dev/null 2>&1; do sleep 30; done
+
+for i in $(seq 1 60); do  # ~6h of 6-min probe cycles
+  missing=""
+  stage_done roofline  || missing="$missing roofline"
+  stage_done io_bench  || missing="$missing io_bench"
+  stage_done inception || missing="$missing inception"
+  [ -z "$missing" ] && { echo "retry-loop: all stages green $(date -u +%T)" \
+    | tee -a "$LOG"; exit 0; }
+  if probe_ok; then
+    echo "retry-loop: probe $i healthy, missing:$missing ($(date -u +%T))" \
+      | tee -a "$LOG"
+    stage_done roofline  || run_stage roofline python tools/bench_roofline.py --out ROOFLINE_r05.json
+    stage_done io_bench  || run_stage io_bench python bench.py --mode io --epochs 3
+    stage_done inception || run_stage inception python bench.py --model inception_bn --steps 20
+  else
+    echo "retry-loop: probe $i wedged ($(date -u +%T))" >> "$LOG"
+  fi
+  sleep 210
+done
+echo "retry-loop: gave up after 60 cycles $(date -u +%T)" | tee -a "$LOG"
