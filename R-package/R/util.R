@@ -13,8 +13,9 @@ mx.util.str.startswith <- function(name, prefix) {
     substring(name, 1, nchar(prefix)) == prefix
 }
 
-# drop NULL entries, preserving names (used when assembling optional
-# argument lists for .C calls)
+# drop NULL entries, preserving names (reference-parity helper: scripts
+# written against the reference's util.R use it to prune optional-argument
+# lists before do.call)
 mx.util.filter.null <- function(lst) {
   lst[!vapply(lst, is.null, logical(1))]
 }

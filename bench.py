@@ -371,7 +371,15 @@ def main():
                     help="resnet50: headline; inception_bn: the BASELINE "
                          "anchor architecture itself (97 img/s on GTX 980) "
                          "for a same-architecture comparison")
+    ap.add_argument("--remat", nargs="?", const=r"unit\d+_out$", default="",
+                    help="rematerialize activations per residual unit "
+                         "(MXNET_TPU_REMAT boundary regex; bare --remat "
+                         "uses the ResNet unit boundaries) — trades MXU "
+                         "recompute for HBM traffic on the bandwidth-bound "
+                         "step")
     args = ap.parse_args()
+    if args.remat:
+        os.environ["MXNET_TPU_REMAT"] = args.remat
 
     # Watchdog first: EVERY mode that can touch the tunnel must fail fast
     # when it wedges (see the note below) instead of eating the driver's

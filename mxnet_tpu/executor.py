@@ -109,50 +109,218 @@ def _fusion_plan(symbol):
         fused_add
 
 
+def _remat_segments(nodes):
+    """Partition the topo order into rematerialization segments.
+
+    ``MXNET_TPU_REMAT`` is a regex; every compute node whose name matches
+    CLOSES a segment (the node is the segment's last member). Each closed
+    segment executes under ``jax.checkpoint``: its interior activations are
+    recomputed in the backward pass instead of being saved, trading MXU
+    FLOPs for HBM traffic — the remaining lever on a bandwidth-bound model
+    (doc/performance.md roofline: activations crossing HBM dominate the
+    step; compute floor sits ~3x below the memory floor). For the ResNet
+    zoo the unit-output relus are the natural boundaries:
+    ``MXNET_TPU_REMAT='unit\\d+_out$'`` saves only the per-unit residual
+    streams. The trailing run after the last boundary (head: pool/fc/loss)
+    stays inline.
+
+    Returns None when the env var is unset/empty, else a list of
+    ``('inline', topo_idx, node) | ('blk', [(topo_idx, node), ...])``
+    segments; each block's external inputs and exports are resolved by
+    _build_graph_fn. Variables never join blocks — their env seeds are
+    dict lookups, and keeping them out makes every block a pure function
+    of real arrays.
+    """
+    import re
+
+    from .base import env_str
+
+    pat = env_str("MXNET_TPU_REMAT", "")
+    if not pat:
+        return None
+    rx = re.compile(pat)
+
+    runs = []  # ('inline', idx, node) | ('blk', [(idx, node), ...])
+    cur = []
+    for i, node in enumerate(nodes):
+        if node.is_variable:
+            runs.append(("inline", i, node))
+            continue
+        cur.append((i, node))
+        if rx.search(node.name):
+            runs.append(("blk", cur))
+            cur = []
+    for i, node in cur:  # tail after the last boundary: head ops, inline
+        runs.append(("inline", i, node))
+
+    return runs
+
+
 def _build_graph_fn(symbol, is_train: bool):
     """Compile the symbol DAG into a pure function of (args, aux, rng)."""
     nodes = symbol._topo()
     fused_bn, passthrough, skip_bn, fused_add = _fusion_plan(symbol)
 
+    def node_aux_names(node):
+        if id(node) in fused_add:
+            bn = fused_add[id(node)][0]
+            return [f"{bn.name}_{a}" for a in bn.op.list_auxiliary_states()]
+        if node.is_variable or id(node) in skip_bn or id(node) in passthrough:
+            return []
+        return [f"{node.name}_{a}" for a in node.op.list_auxiliary_states()]
+
+    def node_input_refs(node):
+        """The env refs exec_node will read for this node (fusion-aware)."""
+        if node.is_variable or id(node) in skip_bn:
+            return []
+        if id(node) in passthrough:
+            src, k = node.inputs[0]
+            return [(id(src), k)]
+        if id(node) in fused_add:
+            bn, z_idx = fused_add[id(node)]
+            z_src, z_k = node.inputs[z_idx]
+            return [(id(s), k) for s, k in bn.inputs] + [(id(z_src), z_k)]
+        return [(id(s), k) for s, k in node.inputs]
+
+    def exec_node(i, node, env, aux_values, new_aux, rng):
+        """Run one compute node: reads env/aux_values, writes env/new_aux."""
+        if id(node) in skip_bn:  # executes inside its fused add below
+            return
+        if id(node) in passthrough:  # relu folded into the producer
+            src, k = node.inputs[0]
+            env[(id(node), 0)] = env[(id(src), k)]
+            return
+        if id(node) in fused_add:
+            bn, z_idx = fused_add[id(node)]
+            bn_ins = [env[(id(s), k)] for s, k in bn.inputs]
+            z = env[(id(node.inputs[z_idx][0]), node.inputs[z_idx][1])]
+            aux_names = node_aux_names(node)
+            aux = [aux_values[a] for a in aux_names]
+            outs, updated = bn.op.fwd_fused_add_relu(
+                bn_ins + [z], aux, is_train, None)
+            env[(id(node), 0)] = outs[0]
+            for a_name, a_val in zip(aux_names, updated):
+                new_aux[a_name] = a_val
+            return
+        ins = [env[(src_id, k)] for src_id, k in
+               [(id(s), k) for s, k in node.inputs]]
+        aux_names = node_aux_names(node)
+        aux = [aux_values[a] for a in aux_names]
+        key = jax.random.fold_in(rng, i) if node.op.need_rng else None
+        if id(node) in fused_bn:
+            outs, updated = node.op.fwd_fused_relu(ins, aux, is_train, key)
+        else:
+            outs, updated = node.op.fwd(ins, aux, is_train, key)
+        for k, o in enumerate(outs):
+            env[(id(node), k)] = o
+        for a_name, a_val in zip(aux_names, updated):
+            new_aux[a_name] = a_val
+
+    segments = _remat_segments(nodes)
+
+    if segments is None:
+        def fn(arg_values: dict, aux_values: dict, rng):
+            env = {}
+            new_aux = dict(aux_values)
+            for i, node in enumerate(nodes):
+                if node.is_variable:
+                    env[(id(node), 0)] = arg_values[node.name]
+                    continue
+                exec_node(i, node, env, aux_values, new_aux, rng)
+            outputs = tuple(env[(id(n), i)] for n, i in symbol._heads)
+            return outputs, new_aux
+
+        return fn
+
+    # -- remat path: resolve each block's external inputs and exports ------
+    head_refs = {(id(n), i) for n, i in symbol._heads}
+    blocks = []  # ('inline', idx, node) | ['blk', members, exts, outs, auxs]
+    for seg in segments:
+        if seg[0] == "inline":
+            blocks.append(seg)
+            continue
+        members = seg[1]
+        member_ids = {id(n) for _, n in members}
+        exts, seen = [], set()
+        for _, node in members:
+            for ref in node_input_refs(node):
+                if ref[0] not in member_ids and ref not in seen:
+                    seen.add(ref)
+                    exts.append(ref)
+        aux_names = []
+        for _, node in members:
+            aux_names.extend(node_aux_names(node))
+        blocks.append(["blk", members, exts, [], aux_names])
+
+    # export = block-produced ref consumed by a LATER block/inline node or
+    # a graph head. Walk again with per-node producer tracking.
+    producer = {}  # node id -> index into blocks (only for blk segments)
+    for bi, seg in enumerate(blocks):
+        if seg[0] == "inline":
+            continue
+        for _, node in seg[1]:
+            # a node may emit several outputs; record by node id, the
+            # consumer side supplies the out_idx
+            producer[id(node)] = bi
+
+    def note_consumption(ref, consumer_bi):
+        node_id, _ = ref
+        pbi = producer.get(node_id)
+        if pbi is not None and pbi != consumer_bi:
+            out_list = blocks[pbi][3]
+            if ref not in out_list:
+                out_list.append(ref)
+
+    for bi, seg in enumerate(blocks):
+        if seg[0] == "inline":
+            for ref in node_input_refs(seg[2]):
+                note_consumption(ref, bi)
+        else:
+            for _, node in seg[1]:
+                for ref in node_input_refs(node):
+                    note_consumption(ref, bi)
+    for ref in head_refs:
+        note_consumption(ref, -1)
+
+    def make_block_fn(members, exts, out_refs, aux_names):
+        def block_fn(ext_vals, aux_vals, rng):
+            env = dict(zip(exts, ext_vals))
+            aux_in = dict(zip(aux_names, aux_vals))
+            new_aux = {}
+            for i, node in members:
+                exec_node(i, node, env, aux_in, new_aux, rng)
+            return (tuple(env[r] for r in out_refs),
+                    tuple(new_aux.get(a, aux_in[a]) for a in aux_names))
+
+        return jax.checkpoint(block_fn)
+
+    compiled_blocks = []
+    for seg in blocks:
+        if seg[0] == "inline":
+            compiled_blocks.append(seg)
+        else:
+            _, members, exts, out_refs, aux_names = seg
+            compiled_blocks.append(
+                ("blk", make_block_fn(members, exts, out_refs, aux_names),
+                 exts, out_refs, aux_names))
+
     def fn(arg_values: dict, aux_values: dict, rng):
         env = {}
         new_aux = dict(aux_values)
-        for i, node in enumerate(nodes):
-            if node.is_variable:
-                env[(id(node), 0)] = arg_values[node.name]
+        for seg in compiled_blocks:
+            if seg[0] == "inline":
+                _, i, node = seg
+                if node.is_variable:
+                    env[(id(node), 0)] = arg_values[node.name]
+                else:
+                    exec_node(i, node, env, aux_values, new_aux, rng)
                 continue
-            if id(node) in skip_bn:  # executes inside its fused add below
-                continue
-            if id(node) in passthrough:  # relu folded into the producer
-                src, k = node.inputs[0]
-                env[(id(node), 0)] = env[(id(src), k)]
-                continue
-            if id(node) in fused_add:
-                bn, z_idx = fused_add[id(node)]
-                bn_ins = [env[(id(s), k)] for s, k in bn.inputs]
-                z = env[(id(node.inputs[z_idx][0]), node.inputs[z_idx][1])]
-                aux_names = [f"{bn.name}_{a}"
-                             for a in bn.op.list_auxiliary_states()]
-                aux = [aux_values[a] for a in aux_names]
-                outs, updated = bn.op.fwd_fused_add_relu(
-                    bn_ins + [z], aux, is_train, None)
-                env[(id(node), 0)] = outs[0]
-                for a_name, a_val in zip(aux_names, updated):
-                    new_aux[a_name] = a_val
-                continue
-            ins = [env[(src_id, k)] for src_id, k in
-                   [(id(s), k) for s, k in node.inputs]]
-            aux_names = [f"{node.name}_{a}" for a in node.op.list_auxiliary_states()]
-            aux = [aux_values[a] for a in aux_names]
-            key = jax.random.fold_in(rng, i) if node.op.need_rng else None
-            if id(node) in fused_bn:
-                outs, updated = node.op.fwd_fused_relu(ins, aux, is_train, key)
-            else:
-                outs, updated = node.op.fwd(ins, aux, is_train, key)
-            for k, o in enumerate(outs):
-                env[(id(node), k)] = o
-            for a_name, a_val in zip(aux_names, updated):
-                new_aux[a_name] = a_val
+            _, block_fn, exts, out_refs, aux_names = seg
+            outs, updated = block_fn(
+                tuple(env[r] for r in exts),
+                tuple(aux_values[a] for a in aux_names), rng)
+            env.update(zip(out_refs, outs))
+            new_aux.update(zip(aux_names, updated))
         outputs = tuple(env[(id(n), i)] for n, i in symbol._heads)
         return outputs, new_aux
 

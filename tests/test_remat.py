@@ -1,0 +1,155 @@
+"""Rematerialization pass (MXNET_TPU_REMAT): segments of the symbol graph
+execute under jax.checkpoint, recomputing interior activations in the
+backward instead of saving them — the HBM-traffic lever for bandwidth-bound
+models (doc/performance.md roofline). Remat must be a pure scheduling
+change: outputs, gradients, and aux updates identical to the inline path.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import executor as ex_mod
+from mxnet_tpu.models import resnet as resnet_fn
+
+
+def _tiny_resnet():
+    # two stages x two units keeps several remat boundaries in a fast graph
+    return resnet_fn((2, 2), num_classes=10, filter_list=(32, 64),
+                         layout="NHWC")
+
+
+def _init(sym, batch=2, hw=16):
+    shapes = {"data": (batch, hw, hw, 3), "softmax_label": (batch,)}
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    rng = np.random.RandomState(0)
+    args, aux = {}, {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in shapes:
+            continue
+        if name.endswith("gamma"):
+            args[name] = jnp.ones(shape, jnp.float32)
+        elif name.endswith(("beta", "bias")):
+            args[name] = jnp.zeros(shape, jnp.float32)
+        else:
+            args[name] = jnp.asarray(
+                rng.randn(*shape).astype(np.float32) * 0.1)
+    for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
+        aux[name] = (jnp.ones(shape, jnp.float32) if name.endswith("var")
+                     else jnp.zeros(shape, jnp.float32))
+    data = jnp.asarray(rng.randn(batch, hw, hw, 3).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 10, batch).astype(np.float32))
+    return args, aux, data, label
+
+
+def _loss_and_grads(sym, remat_pattern, args, aux, data, label):
+    os.environ["MXNET_TPU_REMAT"] = remat_pattern
+    try:
+        fn = ex_mod._build_graph_fn(sym, is_train=True)
+    finally:
+        os.environ.pop("MXNET_TPU_REMAT", None)
+    key = jnp.zeros((2,), jnp.uint32)
+
+    def loss(p):
+        outs, new_aux = fn({**p, "data": data, "softmax_label": label},
+                           aux, key)
+        return jnp.sum(outs[0]), new_aux
+
+    (val, new_aux), grads = jax.value_and_grad(loss, has_aux=True)(args)
+    return val, grads, new_aux
+
+
+def test_remat_matches_inline_exactly():
+    sym = _tiny_resnet()
+    args, aux, data, label = _init(sym)
+    v0, g0, a0 = _loss_and_grads(sym, "", args, aux, data, label)
+    v1, g1, a1 = _loss_and_grads(sym, r"unit\d+_out$", args, aux, data,
+                                 label)
+    np.testing.assert_allclose(np.asarray(v0), np.asarray(v1), rtol=1e-6)
+    assert set(g0) == set(g1)
+    for k in g0:
+        np.testing.assert_allclose(np.asarray(g0[k]), np.asarray(g1[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    assert set(a0) == set(a1)
+    for k in a0:
+        np.testing.assert_allclose(np.asarray(a0[k]), np.asarray(a1[k]),
+                                   rtol=1e-6, err_msg=k)
+
+
+def test_remat_segment_structure():
+    """The boundary regex carves one block per residual unit; stem joins
+    the first block and the head (pool/fc/loss) stays inline."""
+    sym = _tiny_resnet()
+    nodes = sym._topo()
+    os.environ["MXNET_TPU_REMAT"] = r"unit\d+_out$"
+    try:
+        segs = ex_mod._remat_segments(nodes)
+    finally:
+        os.environ.pop("MXNET_TPU_REMAT", None)
+    blk = [s for s in segs if s[0] == "blk"]
+    inline_compute = [s for s in segs
+                      if s[0] == "inline" and not s[2].is_variable]
+    assert len(blk) == 4  # 2 stages x 2 units
+    # every block ends at its unit-output relu
+    for s in blk:
+        assert s[1][-1][1].name.endswith("_out")
+    # the classifier head runs inline after the last boundary
+    tail_names = {n.name for _, _, n in
+                  [s for s in segs if s[0] == "inline"] if not n.is_variable}
+    assert {"global_pool", "flatten", "fc1", "softmax"} <= tail_names
+    assert len(inline_compute) == 4
+
+
+def test_remat_disabled_returns_none():
+    assert ex_mod._remat_segments(_tiny_resnet()._topo()) is None
+
+
+def test_remat_composes_with_fusion_off():
+    """Remat must not depend on the BN fusion pass being active."""
+    sym = _tiny_resnet()
+    args, aux, data, label = _init(sym)
+    os.environ["MXNET_TPU_FUSE"] = "0"
+    try:
+        v0, g0, _ = _loss_and_grads(sym, "", args, aux, data, label)
+        v1, g1, _ = _loss_and_grads(sym, r"unit\d+_out$", args, aux, data,
+                                    label)
+    finally:
+        os.environ.pop("MXNET_TPU_FUSE", None)
+    np.testing.assert_allclose(np.asarray(v0), np.asarray(v1), rtol=1e-6)
+    for k in g0:
+        np.testing.assert_allclose(np.asarray(g0[k]), np.asarray(g1[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_remat_reduces_saved_residuals():
+    """Under jit, the remat step's checkpointed jaxpr must carry fewer
+    saved intermediates across the fwd/bwd boundary. Proxy: count the
+    `remat` primitives and assert the grad jaxpr shrinks in live
+    constants (checkpoint regions collapse their interiors)."""
+    sym = _tiny_resnet()
+    args, aux, data, label = _init(sym)
+
+    def build(pattern):
+        os.environ["MXNET_TPU_REMAT"] = pattern
+        try:
+            fn = ex_mod._build_graph_fn(sym, is_train=True)
+        finally:
+            os.environ.pop("MXNET_TPU_REMAT", None)
+        key = jnp.zeros((2,), jnp.uint32)
+
+        def loss(p):
+            outs, _ = fn({**p, "data": data, "softmax_label": label},
+                         aux, key)
+            return jnp.sum(outs[0])
+
+        return jax.make_jaxpr(jax.grad(loss))(args)
+
+    plain = build("")
+    remat = build(r"unit\d+_out$")
+    n_remat_eqns = sum(1 for e in remat.eqns if "remat" in str(e.primitive))
+    assert n_remat_eqns >= 4, n_remat_eqns  # one checkpoint per unit
+    assert not any("remat" in str(e.primitive) for e in plain.eqns)

@@ -273,9 +273,16 @@ def main():
                     help="compile + per-op traffic table only (no timed "
                          "runs; usable when the tunnel is compile-healthy "
                          "but dispatch-wedged, or on the CPU backend)")
+    ap.add_argument("--remat", nargs="?", const=r"unit\d+_out$", default="",
+                    help="apply MXNET_TPU_REMAT before compiling, to "
+                         "compare saved-activation traffic vs the inline "
+                         "step (bare --remat = ResNet unit boundaries)")
     args = ap.parse_args()
 
     import os
+
+    if args.remat:
+        os.environ["MXNET_TPU_REMAT"] = args.remat
 
     import jax
 
@@ -308,6 +315,7 @@ def main():
     if args.analyze_only:
         out = {
             "batch_size": args.batch_size,
+            "remat": args.remat or None,
             "xla_bytes_accessed_gb": round(traffic / 1e9, 3),
             "analytic_min_traffic_gb": round(
                 analytic_min_traffic_gb(args.batch_size), 2),
@@ -330,6 +338,7 @@ def main():
     floor_flops_ms = flops / (peak * 1e12) * 1e3
     out = {
         "batch_size": args.batch_size,
+        "remat": args.remat or None,
         "measured_step_ms": round(ms, 2),
         "measured_hbm_bw_gbs": round(bw, 1),
         "measured_matmul_peak_tflops": round(peak, 1),

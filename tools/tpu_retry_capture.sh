@@ -37,14 +37,25 @@ for i in $(seq 1 60); do  # ~6h of 6-min probe cycles
   stage_done roofline  || missing="$missing roofline"
   stage_done io_bench  || missing="$missing io_bench"
   stage_done inception || missing="$missing inception"
+  stage_done bench_remat || missing="$missing bench_remat"
   [ -z "$missing" ] && { echo "retry-loop: all stages green $(date -u +%T)" \
     | tee -a "$LOG"; exit 0; }
   if probe_ok; then
     echo "retry-loop: probe $i healthy, missing:$missing ($(date -u +%T))" \
       | tee -a "$LOG"
-    stage_done roofline  || run_stage roofline python tools/bench_roofline.py --out ROOFLINE_r05.json
+    # roofline LAST: its measured phase (multi-GB bandwidth buffers) is the
+    # prime suspect for triggering the tunnel wedge — twice now the wedge
+    # began exactly there (01:17 this session; r03's late-session pattern).
+    # A wedge it causes then costs nothing still queued behind it.
     stage_done io_bench  || run_stage io_bench python bench.py --mode io --epochs 3
     stage_done inception || run_stage inception python bench.py --model inception_bn --steps 20
+    # remat A/B: XLA's cost model charges remat MORE accounted bytes (CPU
+    # compile: 55.5 -> 68.6 GB at b32), but the measured TPU step runs
+    # BELOW the accounted floor — only a hardware A/B vs the plain 103 ms
+    # step decides whether trading MXU recompute for saved-activation
+    # traffic wins here.
+    stage_done bench_remat || run_stage bench_remat python bench.py --steps 20 --remat
+    stage_done roofline  || run_stage roofline python tools/bench_roofline.py --out ROOFLINE_r05.json
   else
     echo "retry-loop: probe $i wedged ($(date -u +%T))" >> "$LOG"
   fi
