@@ -183,17 +183,21 @@ def _build_graph_fn(symbol, is_train: bool):
         return [(id(s), k) for s, k in node.inputs]
 
     def exec_node(i, node, env, aux_values, new_aux, rng):
-        """Run one compute node: reads env/aux_values, writes env/new_aux."""
+        """Run one compute node: reads env/aux_values, writes env/new_aux.
+        Input refs always come from node_input_refs — the single
+        fusion-aware source of truth the remat block resolution also uses,
+        so block externals can never disagree with what runs here."""
         if id(node) in skip_bn:  # executes inside its fused add below
             return
         if id(node) in passthrough:  # relu folded into the producer
-            src, k = node.inputs[0]
-            env[(id(node), 0)] = env[(id(src), k)]
+            env[(id(node), 0)] = env[node_input_refs(node)[0]]
             return
         if id(node) in fused_add:
-            bn, z_idx = fused_add[id(node)]
-            bn_ins = [env[(id(s), k)] for s, k in bn.inputs]
-            z = env[(id(node.inputs[z_idx][0]), node.inputs[z_idx][1])]
+            # node_input_refs ordering contract: bn.inputs..., then z
+            refs = node_input_refs(node)
+            bn = fused_add[id(node)][0]
+            bn_ins = [env[r] for r in refs[:-1]]
+            z = env[refs[-1]]
             aux_names = node_aux_names(node)
             aux = [aux_values[a] for a in aux_names]
             outs, updated = bn.op.fwd_fused_add_relu(
@@ -202,8 +206,7 @@ def _build_graph_fn(symbol, is_train: bool):
             for a_name, a_val in zip(aux_names, updated):
                 new_aux[a_name] = a_val
             return
-        ins = [env[(src_id, k)] for src_id, k in
-               [(id(s), k) for s, k in node.inputs]]
+        ins = [env[r] for r in node_input_refs(node)]
         aux_names = node_aux_names(node)
         aux = [aux_values[a] for a in aux_names]
         key = jax.random.fold_in(rng, i) if node.op.need_rng else None

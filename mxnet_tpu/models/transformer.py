@@ -31,8 +31,15 @@ __all__ = ["transformer_lm_config", "TransformerLM"]
 
 def transformer_lm_config(vocab_size=32000, d_model=512, n_heads=8, n_layers=4,
                           d_ff=None, max_len=2048, dtype=jnp.bfloat16,
-                          attn_impl="auto"):
-    """attn_impl: 'flash' (Pallas kernel), 'dense', or 'auto' (flash on TPU)."""
+                          attn_impl="auto", remat=False):
+    """attn_impl: 'flash' (Pallas kernel), 'dense', or 'auto' (flash on TPU).
+
+    ``remat``: run each decoder layer under ``jax.checkpoint`` — backward
+    recomputes the layer instead of saving its interior activations, so
+    saved-activation memory drops from O(n_layers * seq * d_ff) to
+    O(n_layers * seq * d_model): the standard long-context lever (with
+    ring attention over sp it is what lets sequence length scale to the
+    HBM limit of the boundary activations alone)."""
     return {
         "vocab_size": vocab_size,
         "d_model": d_model,
@@ -42,6 +49,7 @@ def transformer_lm_config(vocab_size=32000, d_model=512, n_heads=8, n_layers=4,
         "max_len": max_len,
         "dtype": dtype,
         "attn_impl": attn_impl,
+        "remat": remat,
     }
 
 
@@ -142,11 +150,10 @@ class TransformerLM:
         x = x + params["pos_embed"][:seq].astype(dtype)
         x = cst(x, P("dp", "sp", None))
 
-        for i in range(cfg["n_layers"]):
+        def layer_fn(x, lp):
             # attention block
-            y = _layernorm(x, params[f"layer{i}_ln1_scale"],
-                           params[f"layer{i}_ln1_bias"])
-            qkv = jnp.einsum("bsd,df->bsf", y, params[f"layer{i}_wqkv"].astype(dtype),
+            y = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"])
+            qkv = jnp.einsum("bsd,df->bsf", y, lp["wqkv"].astype(dtype),
                              preferred_element_type=jnp.float32).astype(dtype)
             qkv = qkv.reshape(qkv.shape[0], seq, 3, h, hd)
             q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
@@ -180,22 +187,31 @@ class TransformerLM:
             else:
                 attn = attention_reference(q, k, v, causal=True)
             attn = attn.transpose(0, 2, 1, 3).reshape(x.shape[0], seq, d)
-            attn = jnp.einsum("bsd,df->bsf", attn, params[f"layer{i}_wo"].astype(dtype),
+            attn = jnp.einsum("bsd,df->bsf", attn, lp["wo"].astype(dtype),
                               preferred_element_type=jnp.float32).astype(dtype)
             x = cst(x + attn, P("dp", "sp", None))
 
             # mlp block (column-parallel w1, row-parallel w2)
-            y = _layernorm(x, params[f"layer{i}_ln2_scale"],
-                           params[f"layer{i}_ln2_bias"])
-            u = jnp.einsum("bsd,df->bsf", y, params[f"layer{i}_w1"].astype(dtype),
+            y = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"])
+            u = jnp.einsum("bsd,df->bsf", y, lp["w1"].astype(dtype),
                            preferred_element_type=jnp.float32).astype(dtype)
-            u = u + params[f"layer{i}_b1"].astype(dtype)
+            u = u + lp["b1"].astype(dtype)
             u = cst(u, P("dp", "sp", "tp"))
             u = jax.nn.gelu(u)
-            z = jnp.einsum("bsf,fd->bsd", u, params[f"layer{i}_w2"].astype(dtype),
+            z = jnp.einsum("bsf,fd->bsd", u, lp["w2"].astype(dtype),
                            preferred_element_type=jnp.float32).astype(dtype)
-            z = z + params[f"layer{i}_b2"].astype(dtype)
-            x = cst(x + z, P("dp", "sp", None))
+            z = z + lp["b2"].astype(dtype)
+            return cst(x + z, P("dp", "sp", None))
+
+        if cfg.get("remat"):
+            # per-layer activation recompute: only the layer-boundary x is
+            # saved for backward (see transformer_lm_config docstring)
+            layer_fn = jax.checkpoint(layer_fn)
+        layer_param_names = ("ln1_scale", "ln1_bias", "wqkv", "wo",
+                             "ln2_scale", "ln2_bias", "w1", "b1", "w2", "b2")
+        for i in range(cfg["n_layers"]):
+            x = layer_fn(x, {n: params[f"layer{i}_{n}"]
+                             for n in layer_param_names})
 
         x = _layernorm(x, params["final_norm_scale"], params["final_norm_bias"])
         logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(dtype),

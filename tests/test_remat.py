@@ -7,6 +7,8 @@ change: outputs, gradients, and aux updates identical to the inline path.
 
 import os
 
+from contextlib import contextmanager
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -15,6 +17,24 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import executor as ex_mod
 from mxnet_tpu.models import resnet as resnet_fn
+
+
+@contextmanager
+def _env(name, value):
+    """Set/unset an env var, restoring any pre-existing value on exit (a
+    CI job may export MXNET_TPU_FUSE/REMAT for the whole session)."""
+    prev = os.environ.get(name)
+    if value:
+        os.environ[name] = value
+    else:
+        os.environ.pop(name, None)
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
 
 
 def _tiny_resnet():
@@ -47,11 +67,8 @@ def _init(sym, batch=2, hw=16):
 
 
 def _loss_and_grads(sym, remat_pattern, args, aux, data, label):
-    os.environ["MXNET_TPU_REMAT"] = remat_pattern
-    try:
+    with _env("MXNET_TPU_REMAT", remat_pattern):
         fn = ex_mod._build_graph_fn(sym, is_train=True)
-    finally:
-        os.environ.pop("MXNET_TPU_REMAT", None)
     key = jnp.zeros((2,), jnp.uint32)
 
     def loss(p):
@@ -85,11 +102,8 @@ def test_remat_segment_structure():
     the first block and the head (pool/fc/loss) stays inline."""
     sym = _tiny_resnet()
     nodes = sym._topo()
-    os.environ["MXNET_TPU_REMAT"] = r"unit\d+_out$"
-    try:
+    with _env("MXNET_TPU_REMAT", r"unit\d+_out$"):
         segs = ex_mod._remat_segments(nodes)
-    finally:
-        os.environ.pop("MXNET_TPU_REMAT", None)
     blk = [s for s in segs if s[0] == "blk"]
     inline_compute = [s for s in segs
                       if s[0] == "inline" and not s[2].is_variable]
@@ -112,13 +126,10 @@ def test_remat_composes_with_fusion_off():
     """Remat must not depend on the BN fusion pass being active."""
     sym = _tiny_resnet()
     args, aux, data, label = _init(sym)
-    os.environ["MXNET_TPU_FUSE"] = "0"
-    try:
+    with _env("MXNET_TPU_FUSE", "0"):
         v0, g0, _ = _loss_and_grads(sym, "", args, aux, data, label)
         v1, g1, _ = _loss_and_grads(sym, r"unit\d+_out$", args, aux, data,
                                     label)
-    finally:
-        os.environ.pop("MXNET_TPU_FUSE", None)
     np.testing.assert_allclose(np.asarray(v0), np.asarray(v1), rtol=1e-6)
     for k in g0:
         np.testing.assert_allclose(np.asarray(g0[k]), np.asarray(g1[k]),
@@ -134,11 +145,8 @@ def test_remat_reduces_saved_residuals():
     args, aux, data, label = _init(sym)
 
     def build(pattern):
-        os.environ["MXNET_TPU_REMAT"] = pattern
-        try:
+        with _env("MXNET_TPU_REMAT", pattern):
             fn = ex_mod._build_graph_fn(sym, is_train=True)
-        finally:
-            os.environ.pop("MXNET_TPU_REMAT", None)
         key = jnp.zeros((2,), jnp.uint32)
 
         def loss(p):
@@ -153,3 +161,37 @@ def test_remat_reduces_saved_residuals():
     n_remat_eqns = sum(1 for e in remat.eqns if "remat" in str(e.primitive))
     assert n_remat_eqns >= 4, n_remat_eqns  # one checkpoint per unit
     assert not any("remat" in str(e.primitive) for e in plain.eqns)
+
+
+def test_transformer_layer_remat_matches():
+    """TransformerLM(remat=True): per-layer jax.checkpoint must be a pure
+    scheduling change — loss and grads identical to the inline model —
+    and its grad jaxpr must actually carry remat regions."""
+    from mxnet_tpu.models.transformer import (TransformerLM,
+                                              transformer_lm_config)
+
+    cfg = transformer_lm_config(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, max_len=16, dtype=jnp.float32,
+                                attn_impl="dense")
+    lm = TransformerLM(cfg)
+    params = lm.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, (2, 16)), jnp.int32)
+    targets = jnp.asarray(rng.randint(0, 64, (2, 16)), jnp.int32)
+
+    cfg_r = dict(cfg, remat=True)
+    lm_r = TransformerLM(cfg_r)
+
+    def loss_fn(model):
+        return lambda p: model.loss(p, tokens, targets)
+
+    l0, g0 = jax.value_and_grad(loss_fn(lm))(params)
+    l1, g1 = jax.value_and_grad(loss_fn(lm_r))(params)
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), rtol=1e-6)
+    for k in g0:
+        np.testing.assert_allclose(np.asarray(g0[k]), np.asarray(g1[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss_fn(lm_r)))(params)
+    assert sum(1 for e in jaxpr.eqns
+               if "remat" in str(e.primitive)) >= 2  # one per layer

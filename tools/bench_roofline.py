@@ -315,7 +315,7 @@ def main():
     if args.analyze_only:
         out = {
             "batch_size": args.batch_size,
-            "remat": args.remat or None,
+            "remat": os.environ.get("MXNET_TPU_REMAT") or None,
             "xla_bytes_accessed_gb": round(traffic / 1e9, 3),
             "analytic_min_traffic_gb": round(
                 analytic_min_traffic_gb(args.batch_size), 2),
@@ -338,7 +338,7 @@ def main():
     floor_flops_ms = flops / (peak * 1e12) * 1e3
     out = {
         "batch_size": args.batch_size,
-        "remat": args.remat or None,
+        "remat": os.environ.get("MXNET_TPU_REMAT") or None,
         "measured_step_ms": round(ms, 2),
         "measured_hbm_bw_gbs": round(bw, 1),
         "measured_matmul_peak_tflops": round(peak, 1),
