@@ -44,6 +44,10 @@ def main():
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--steps", type=int, default=600)
     ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--remat", action="store_true",
+                    help="jax.checkpoint per decoder layer: saved "
+                         "activations shrink to the layer boundaries, "
+                         "letting --seq scale past the no-remat HBM limit")
     ap.add_argument("--flash", action="store_true",
                     help="flash formulation for the rank-local block")
     ap.add_argument("--cpu", action="store_true", default=True)
@@ -70,7 +74,7 @@ def main():
     cfg = transformer_lm_config(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
         n_layers=args.layers, max_len=args.seq, dtype=jnp.float32,
-        attn_impl="flash" if args.flash else "auto")
+        attn_impl="flash" if args.flash else "auto", remat=args.remat)
     model = TransformerLM(cfg)
     params, moms = model.init_sharded(mesh, seed=0)
     step = model.make_train_step(mesh, lr=0.1)
