@@ -1,0 +1,24 @@
+# NDArray micro-benchmark from R (reference capability:
+# R-package/demo/basic_bench.R — time device ops driven from the binding;
+# the point is that R only dispatches, the runtime does the math).
+
+source(file.path("demo", "demo_loader.R"))
+
+mx.set.seed(0)
+n <- 256L
+a <- mx.runif(c(n, n))
+b <- mx.runif(c(n, n))
+
+iters <- 50
+t0 <- proc.time()[["elapsed"]]
+out <- a
+for (i in seq_len(iters)) {
+  out <- mx.nd.dot(out, b)
+  out <- out / mx.nd.norm(out)   # keep values bounded, chain the result
+}
+sync <- as.array(mx.nd.norm(out))  # readback fences the device queue
+t1 <- proc.time()[["elapsed"]]
+
+gflop <- iters * 2 * as.double(n)^3 / 1e9
+cat(sprintf("%d chained %dx%d dots: %.3f s (%.1f GFLOP/s)\n",
+            iters, n, n, t1 - t0, gflop / (t1 - t0)))

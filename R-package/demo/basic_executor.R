@@ -1,0 +1,48 @@
+# Basic executor usage from R (reference capability:
+# R-package/demo/basic_executor.R — bind a symbol over explicit argument
+# arrays, run forward/backward, read outputs and gradients).
+
+source(file.path("demo", "demo_loader.R"))
+
+data <- mx.symbol.Variable("data")
+fc <- mx.symbol.FullyConnected(data = data, num_hidden = 4, name = "fc")
+net <- mx.symbol.SoftmaxOutput(data = fc, name = "softmax")
+
+batch <- 2L
+shapes <- mx.symbol.infer.shapes(net, c(batch, 3L))
+arg_names <- mx.symbol.arguments(net)
+print(arg_names)
+
+mx.set.seed(0)
+args <- integer(length(arg_names))
+grads <- integer(length(arg_names))
+reqs <- integer(length(arg_names))
+for (i in seq_along(arg_names)) {
+  shp <- shapes$arg_shapes[[i]]
+  if (arg_names[i] == "data") {
+    args[i] <- mx.nd.array(matrix(c(1, 2, 3, 4, 5, 6), nrow = batch,
+                                  byrow = TRUE))
+  } else if (mx.util.str.endswith(arg_names[i], "label")) {
+    args[i] <- mx.nd.array(c(0, 3))
+  } else {
+    args[i] <- mx.runif(shp, min = -0.1, max = 0.1)
+  }
+  is_param <- arg_names[i] != "data" &&
+    !mx.util.str.endswith(arg_names[i], "label")
+  if (is_param) {
+    grads[i] <- mx.nd.zeros(shp)
+    reqs[i] <- 1L
+  }
+}
+
+ex <- mx.executor.bind(net, args, grads, reqs, integer(0))
+mx.executor.forward(ex, is.train = TRUE)
+outs <- mx.executor.outputs(ex)
+cat("softmax output:\n")
+print(as.array(outs[[1]]))
+
+# SoftmaxOutput injects the cross-entropy gradient at the head
+mx.executor.backward(ex)
+widx <- which(arg_names == "fc_weight")
+cat("d loss / d fc_weight:\n")
+print(as.array(structure(grads[widx], class = "mxtpu.ndarray")))

@@ -1,0 +1,22 @@
+# Basic kvstore usage from R (reference capability:
+# R-package/demo/basic_kvstore.R — init/push/pull on a local store, the
+# aggregation primitive FeedForward's multi-device training rides on).
+
+source(file.path("demo", "demo_loader.R"))
+
+kv <- mx.kv.create("local")
+cat(sprintf("rank %d of %d workers\n", mx.kv.rank(kv), mx.kv.num.workers(kv)))
+
+shape <- c(2L, 3L)
+mx.kv.init(kv, 3L, list(mx.nd.array(array(1, shape))))
+
+# pushing several values under ONE key aggregates them (sum) in the store
+g1 <- mx.nd.array(array(2, shape))
+g2 <- mx.nd.array(array(5, shape))
+mx.kv.push(kv, c(3L, 3L), list(g1, g2))
+
+out <- mx.nd.zeros(shape)
+mx.kv.pull(kv, 3L, list(out))
+print(as.array(out))   # all 7 = 2 + 5
+
+mx.kv.free(kv)

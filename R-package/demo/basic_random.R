@@ -1,0 +1,18 @@
+# Device RNG from R (reference capability:
+# R-package/demo/basic_random.R — mx.set.seed drives the framework RNG,
+# separate from R's set.seed; samplers run inside the runtime).
+
+source(file.path("demo", "demo_loader.R"))
+
+mx.set.seed(42)
+u1 <- as.array(mx.runif(c(2L, 3L), min = 0, max = 1))
+n1 <- as.array(mx.rnorm(c(2L, 3L), mean = 0, sd = 2))
+
+# re-seeding reproduces the exact stream
+mx.set.seed(42)
+u2 <- as.array(mx.runif(c(2L, 3L), min = 0, max = 1))
+n2 <- as.array(mx.rnorm(c(2L, 3L), mean = 0, sd = 2))
+
+stopifnot(identical(u1, u2), identical(n1, n2))
+print(u1)
+print(n1)

@@ -1,0 +1,29 @@
+# Shared prologue for the basic_* demos (reference capability:
+# R-package/demo/ — the reference demos open with require(mxnet); without
+# an installed package the equivalent is loading the .C shim and sourcing
+# the R layer into one namespace, the same order demo/lenet_train.R uses).
+#
+# Run any demo from the R-package directory with the shims built:
+#   make -C ../mxnet_tpu/native capi
+#   g++ -O2 -std=c++17 -fPIC -shared src/mxtpu_r_train.cc \
+#       -o src/libmxtpu_r_train.so -L../mxnet_tpu/native -lmxtpu_capi \
+#       -Wl,-rpath,$(realpath ../mxnet_tpu/native)
+#   PYTHONPATH=$(realpath ..) Rscript demo/basic_ndarray.R
+
+dyn.load(file.path("src", "libmxtpu_r_train.so"))
+source(file.path("R", "mxtpu_train.R"))
+source(file.path("R", "ndarray.R"))
+source(file.path("R", "symbol.R"))
+source(file.path("R", "executor.R"))
+source(file.path("R", "mxtpu_generated.R"))
+source(file.path("R", "optimizer.R"))
+source(file.path("R", "initializer.R"))
+source(file.path("R", "metric.R"))
+source(file.path("R", "callback.R"))
+source(file.path("R", "io.R"))
+source(file.path("R", "kvstore.R"))
+source(file.path("R", "model.R"))
+source(file.path("R", "util.R"))
+source(file.path("R", "context.R"))
+source(file.path("R", "random.R"))
+source(file.path("R", "viz.graph.R"))

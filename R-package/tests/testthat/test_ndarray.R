@@ -1,0 +1,56 @@
+# ndarray tier (reference capability: R-package/tests/testthat/
+# test_ndarray.R — elementwise arithmetic incl. reversed scalar forms,
+# zeros, save/load). Written against the runtime-backed ndarray.R layer.
+
+context("ndarray")
+
+test_that("element-wise calculation for vector", {
+  x <- as.double(1:10)
+  mat <- mx.nd.array(x)
+  expect_equal(x, as.vector(as.array(mat)))
+  expect_equal(x + 1, as.vector(as.array(mat + 1)))
+  expect_equal(x - 10, as.vector(as.array(mat - 10)))
+  expect_equal(x * 20, as.vector(as.array(mat * 20)))
+  expect_equal(x / 3, as.vector(as.array(mat / 3)), tolerance = 1e-5)
+  expect_equal(-1 - x, as.vector(as.array(-1 - mat)))
+  expect_equal(-5 / x, as.vector(as.array(-5 / mat)), tolerance = 1e-5)
+  expect_equal(x + x, as.vector(as.array(mat + mat)))
+  expect_equal(x / x, as.vector(as.array(mat / mat)))
+  expect_equal(x * x, as.vector(as.array(mat * mat)))
+  expect_equal(x - x, as.vector(as.array(mat - mat)))
+})
+
+test_that("element-wise calculation for matrix", {
+  x <- matrix(as.double(1:4), 2, 2)
+  mat <- mx.nd.array(x)
+  expect_equal(x, as.array(mat))
+  expect_equal(x + 1, as.array(mat + 1))
+  expect_equal(x * 20, as.array(mat * 20))
+  expect_equal(-1 - x, as.array(-1 - mat))
+  expect_equal(-5 / x, as.array(-5 / mat), tolerance = 1e-5)
+  expect_equal(x * x, as.array(mat * mat))
+})
+
+test_that("ndarray zeros, dot, norm, save and load", {
+  expect_equal(rep(0, 10), as.vector(as.array(mx.nd.zeros(10L))))
+  expect_equal(matrix(0, 10, 5), as.array(mx.nd.zeros(c(10L, 5L))))
+  a <- mx.nd.array(matrix(as.double(1:6), 2, 3))
+  b <- mx.nd.array(matrix(as.double(1:6), 3, 2))
+  d <- mx.nd.dot(a, b)
+  expect_equal(mx.nd.shape(d), c(2L, 2L))
+  expect_equal(as.vector(as.array(mx.nd.norm(d))),
+               sqrt(sum(as.array(d)^2)), tolerance = 1e-5)
+  fname <- tempfile(fileext = ".nd")
+  mx.nd.save(list(mat = d), fname)
+  back <- mx.nd.load(fname)
+  expect_equal(as.array(back[["mat"]]), as.array(d))
+  file.remove(fname)
+})
+
+test_that("device RNG reproduces under mx.set.seed", {
+  mx.set.seed(7)
+  u1 <- as.array(mx.runif(c(3L, 3L)))
+  mx.set.seed(7)
+  u2 <- as.array(mx.runif(c(3L, 3L)))
+  expect_identical(u1, u2)
+})

@@ -1,0 +1,36 @@
+# symbol tier (reference capability: R-package/tests/testthat/
+# test_symbol.R — compose, list arguments, JSON round-trip, shape
+# inference). Written against the runtime-backed symbol.R layer.
+
+context("symbol")
+
+mlp <- function() {
+  data <- mx.symbol.Variable("data")
+  fc1 <- mx.symbol.FullyConnected(data = data, num_hidden = 100,
+                                  name = "fc1")
+  act <- mx.symbol.Activation(data = fc1, act_type = "relu", name = "relu1")
+  fc2 <- mx.symbol.FullyConnected(data = act, num_hidden = 10, name = "fc2")
+  mx.symbol.SoftmaxOutput(data = fc2, name = "softmax")
+}
+
+test_that("basic symbol operation", {
+  net <- mlp()
+  expect_true("fc1_weight" %in% mx.symbol.arguments(net))
+  expect_true("softmax_label" %in% mx.symbol.arguments(net))
+})
+
+test_that("symbol JSON round-trip preserves the graph", {
+  net <- mlp()
+  js <- mx.symbol.tojson(net)
+  net2 <- mx.symbol.fromjson(js)
+  expect_identical(mx.symbol.arguments(net2), mx.symbol.arguments(net))
+  expect_identical(mx.symbol.tojson(net2), js)
+})
+
+test_that("shape inference fills parameter shapes from the data shape", {
+  net <- mlp()
+  shapes <- mx.symbol.infer.shapes(net, c(32L, 784L))
+  names(shapes$arg_shapes) <- mx.symbol.arguments(net)
+  expect_equal(shapes$arg_shapes[["fc1_weight"]], c(100L, 784L))
+  expect_equal(shapes$arg_shapes[["fc2_bias"]], c(10L))
+})

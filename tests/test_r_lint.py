@@ -1,7 +1,8 @@
 """R-source lint tier (VERDICT r4: the image ships no R interpreter, so the
 .R layer needs at least a syntax/contract pass in CI).
 
-Three checks over every file in R-package/R/ and R-package/demo/:
+Three checks over every .R file in R-package/R/, demo/, tests/, and
+tests/testthat/:
 
 1. token-level balance lint: parens/brackets/braces balanced outside
    strings and comments, no unterminated strings — catches the syntax
@@ -19,7 +20,10 @@ import re
 
 ROOT = os.path.join(os.path.dirname(__file__), "..")
 R_FILES = sorted(glob.glob(os.path.join(ROOT, "R-package", "R", "*.R")) +
-                 glob.glob(os.path.join(ROOT, "R-package", "demo", "*.R")))
+                 glob.glob(os.path.join(ROOT, "R-package", "demo", "*.R")) +
+                 glob.glob(os.path.join(ROOT, "R-package", "tests", "*.R")) +
+                 glob.glob(os.path.join(ROOT, "R-package", "tests",
+                                        "testthat", "*.R")))
 SHIM_SRC = glob.glob(os.path.join(ROOT, "R-package", "src", "*.cc"))
 
 
