@@ -122,6 +122,40 @@ def test_r_generated_current():
     assert r.returncode == 0, (r.stdout + r.stderr)[-1500:]
 
 
+def test_r_man_current():
+    """R-package/man/*.Rd must match a fresh tools/gen_r_docs.py run —
+    every exported definition documented, no stale or hand-edited pages."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "gen_r_docs", os.path.join(ROOT, "tools", "gen_r_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    fresh = mod.generate()
+    man_dir = os.path.join(ROOT, "R-package", "man")
+    on_disk = {os.path.basename(p) for p in
+               glob.glob(os.path.join(man_dir, "*.Rd"))}
+    assert on_disk == set(fresh), (
+        f"stale: {sorted(on_disk - set(fresh))[:5]} "
+        f"missing: {sorted(set(fresh) - on_disk)[:5]} — "
+        "run python tools/gen_r_docs.py")
+    for fname, content in fresh.items():
+        with open(os.path.join(man_dir, fname)) as f:
+            assert f.read() == content, \
+                f"{fname} differs — run python tools/gen_r_docs.py"
+    # the titles table must not accumulate entries for definitions that no
+    # longer exist, and an entry whose definition has since gained an
+    # inline comment block is dead too (the block wins in _title_from) —
+    # prune it so the table never shadows real doc comments
+    entries = mod.collect()
+    orphans = set(mod.TITLES) - set(entries)
+    assert not orphans, f"TITLES entries without definitions: {orphans}"
+    shadowed = {n for n in mod.TITLES if entries[n][2]}
+    assert not shadowed, \
+        f"TITLES entries superseded by inline comments: {shadowed}"
+
+
 def test_r_reference_surface_checklist():
     """Executable R-surface parity checklist (the judge's inventory check
     for R-package/, mirroring tests/test_api_surface.py for Python): the
