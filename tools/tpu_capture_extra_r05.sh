@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-5 follow-up capture — fires after tools/tpu_capture_all.sh in the
+# same healthy tunnel window. Two goals:
+#   1. FLASH_r05.json: re-measure the Pallas flash-attention sweep on the
+#      current HEAD (last hardware sweep was round 3).
+#   2. Batch-size exploration: the headline step is bandwidth-bound with a
+#      ~2.5 GB/step fixed param-update stream, so larger batches amortize
+#      it; measure b384/b512 to see whether the default (256) leaves
+#      throughput on the table (OOM at 512 is an acceptable outcome —
+#      stages are independent).
+set -u
+cd "$(dirname "$0")/.."
+LOG=TPU_CAPTURE_r05.log
+echo "=== extra capture start $(date -u +%FT%TZ)" | tee -a "$LOG"
+
+run_stage() {
+  local name="$1"; shift
+  echo "--- $name: $* ($(date -u +%T))" | tee -a "$LOG"
+  local t0=$SECONDS
+  timeout 2000 "$@" >> "$LOG" 2>&1
+  local rc=$?
+  echo "--- $name done rc=$rc in $((SECONDS-t0))s" | tee -a "$LOG"
+  return $rc
+}
+
+run_stage flash python tools/bench_flash.py --out FLASH_r05.json
+run_stage bench_b384 python bench.py --steps 20 --batch-size 384
+run_stage bench_b512 python bench.py --steps 20 --batch-size 512
+echo "=== extra capture end $(date -u +%FT%TZ)" | tee -a "$LOG"
