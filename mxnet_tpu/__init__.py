@@ -12,6 +12,10 @@ Layering (cf. SURVEY.md §1):
   initializer/callback
   parallel/                - meshes, shard specs, collectives, ring attention
   models/                  - the model zoo (MLP..ResNet-50, LSTM, transformer)
+  compat                   - JAX version shims (the only module allowed to
+                             probe fragile API locations; mxlint MX101)
+  analysis/                - mxlint: source lint, Symbol.verify graph pass,
+                             jaxpr audit (doc/developer-guide/static_analysis.md)
 """
 
 # Join the jax.distributed world BEFORE anything touches a backend: under
@@ -29,7 +33,9 @@ def _join_launcher_world():
         return
     import jax
 
-    if jax.distributed.is_initialized():
+    from .compat import distributed_initialized
+
+    if distributed_initialized():
         return
     jax.distributed.initialize(coord, num_processes=nproc,
                                process_id=int(rank))
@@ -37,7 +43,7 @@ def _join_launcher_world():
 
 _join_launcher_world()
 
-from . import base, context, engine
+from . import base, compat, context, engine
 from .base import MXNetError
 from .context import Context, cpu, cpu_pinned, current_context, gpu, num_devices, tpu
 from . import ndarray
@@ -81,5 +87,6 @@ from . import models
 from . import utils
 from . import predictor as _predictor_mod
 from .predictor import Predictor
+from . import analysis
 
 __version__ = "0.1.0"

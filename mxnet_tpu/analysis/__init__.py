@@ -1,0 +1,61 @@
+"""mxnet_tpu.analysis — the mxlint static-analysis subsystem (ISSUE 1).
+
+Three passes over three representations of the same program:
+
+  Pass 1  source lint   (`source_lint`)  — AST walk over .py files:
+          version-fragile JAX imports, host-sync hazards in traced code,
+          recompilation risks. Pure AST work: linted files are never
+          imported or traced.
+  Pass 2  graph verify   (`graph`)       — ``Symbol.verify()``: full
+          static shape *and dtype* inference over the node DAG plus
+          structural checks, run automatically on every bind
+          (reference: StaticGraph::InferShape).
+  Pass 3  jaxpr audit    (`jaxpr_audit`) — inspects a bound executor's
+          traced jaxpr for host transfers, dtype promotions, and per-op
+          FLOP/byte totals (feeds tools/bench_roofline.py).
+
+Rules live in a registry (`rules`) keyed by stable ids (MX101, ...), each
+with a severity and a fixit hint — adding a rule never touches a driver.
+CLI: ``python -m mxnet_tpu.analysis [paths]`` (wrapped by
+tools/run_mxlint.py; the self-lint gates the tier-1 suite via
+tests/test_mxlint.py).
+
+Suppression: ``# mxlint: disable=MX101`` on the offending line, or
+``# mxlint: skip-file`` in the first five lines.
+"""
+
+from .rules import RULES, Finding, Rule, get_rule, register_rule
+from .source_lint import lint_file, lint_paths, lint_source
+from .graph import verify_json, verify_json_file, verify_symbol
+
+__all__ = [
+    "RULES", "Finding", "Rule", "get_rule", "register_rule",
+    "lint_file", "lint_paths", "lint_source",
+    "verify_json", "verify_json_file", "verify_symbol",
+    "audit_executor", "audit_jaxpr", "cost_rows", "main",
+]
+
+
+def audit_executor(*args, **kwargs):
+    """Lazy re-export: Pass 3 pulls in jax; keep the CLI import-light."""
+    from .jaxpr_audit import audit_executor as impl
+
+    return impl(*args, **kwargs)
+
+
+def audit_jaxpr(*args, **kwargs):
+    from .jaxpr_audit import audit_jaxpr as impl
+
+    return impl(*args, **kwargs)
+
+
+def cost_rows(*args, **kwargs):
+    from .jaxpr_audit import cost_rows as impl
+
+    return impl(*args, **kwargs)
+
+
+def main(argv=None) -> int:
+    from .__main__ import main as impl
+
+    return impl(argv)

@@ -1,0 +1,95 @@
+"""mxlint CLI: ``python -m mxnet_tpu.analysis [paths...]``.
+
+Paths may be .py files, directories (recursively linted, Pass 1), or
+serialized symbol .json files (graph-verified, Pass 2 + unreachable-node
+check). Exit code 1 when any error-severity finding survives filtering,
+else 0 — this is the contract tests/test_mxlint.py and the tier-1
+self-lint rely on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .rules import RULES
+from .source_lint import iter_python_files, lint_file
+
+
+def _parser():
+    p = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="mxlint: static analysis for mxnet_tpu "
+                    "(API-compat, traced-code hazards, graph verification)")
+    p.add_argument("paths", nargs="*", default=[],
+                   help=".py files, directories, or symbol .json files "
+                        "(default: the installed mxnet_tpu package tree)")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule ids to report (default: all)")
+    p.add_argument("--ignore", default="",
+                   help="comma-separated rule ids to drop")
+    p.add_argument("--warnings-as-errors", action="store_true",
+                   help="exit 1 on warnings too")
+    p.add_argument("--quiet", action="store_true",
+                   help="print only the summary line")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{rule.id}  [{rule.severity:7s}] {rule.summary}")
+        return 0
+
+    select = {s.strip() for s in args.select.split(",") if s.strip()}
+    ignore = {s.strip() for s in args.ignore.split(",") if s.strip()}
+
+    # default target: the package tree itself, wherever it is installed —
+    # cwd-independent so `python -m mxnet_tpu.analysis` works from anywhere
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        for p in missing:
+            print(f"mxlint: no such path: {p}", file=sys.stderr)
+        return 2
+
+    findings = []
+    n_files = 0
+    for path in paths:
+        if path.endswith(".json"):
+            from .graph import verify_json_file
+
+            n_files += 1
+            findings.extend(verify_json_file(path))
+            continue
+        for f in iter_python_files([path]):
+            n_files += 1
+            findings.extend(lint_file(f))
+
+    if select:
+        findings = [f for f in findings if f.rule.id in select]
+    if ignore:
+        findings = [f for f in findings if f.rule.id not in ignore]
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule.id))
+    errors = [f for f in findings if f.is_error]
+    warnings = [f for f in findings if f.rule.severity == "warning"]
+
+    if not args.quiet:
+        for f in findings:
+            print(f.format())
+    print(f"mxlint: checked {n_files} file(s): "
+          f"{len(errors)} error(s), {len(warnings)} warning(s)")
+    if errors or (args.warnings_as_errors and warnings):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

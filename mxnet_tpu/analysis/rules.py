@@ -1,0 +1,183 @@
+"""Rule registry and finding types for mxlint.
+
+Reference analogue: the reference caught whole classes of graph errors
+before execution inside ``StaticGraph::InferShape`` (src/symbol/
+static_graph.cc), but each check was hard-wired into the pass. Here every
+check — source-level, graph-level, jaxpr-level — is a registered ``Rule``
+with a stable id, a severity, and a fixit hint, so later PRs add rules
+without touching any driver (ISSUE 1 tentpole contract).
+
+Rule id bands:
+  MX1xx  API compatibility (version-fragile / deprecated JAX imports)
+  MX2xx  traced-code hazards (host sync, numpy in traced fns)
+  MX3xx  recompilation risks (static-arg hashing, f-strings under trace)
+  MX4xx  graph verifier (Symbol.verify: shapes, dtypes, names, dead code)
+  MX5xx  jaxpr auditor (host transfers, dtype promotions)
+
+Severities: ``error`` fails the CLI (exit 1) and makes ``Symbol.verify``
+raise; ``warning`` is reported but non-fatal; ``info`` is advisory output.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["Rule", "Finding", "RULES", "register_rule", "get_rule",
+           "SEVERITIES"]
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One static-analysis rule: stable id + severity + fixit hint."""
+
+    id: str
+    severity: str
+    summary: str
+    fixit: str = ""
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"rule {self.id}: bad severity {self.severity!r}")
+
+
+RULES: dict[str, Rule] = {}
+
+
+def register_rule(rule_id: str, severity: str, summary: str,
+                  fixit: str = "") -> Rule:
+    """Register a rule under a stable id; re-registration must be identical
+    (rules are contract surface — tests and suppression pragmas key on ids).
+    """
+    rule = Rule(rule_id, severity, summary, fixit)
+    prev = RULES.get(rule_id)
+    if prev is not None and prev != rule:
+        raise ValueError(f"conflicting registration for rule {rule_id}")
+    RULES[rule_id] = rule
+    return rule
+
+
+def get_rule(rule_id: str) -> Rule:
+    return RULES[rule_id]
+
+
+@dataclass
+class Finding:
+    """One diagnostic: a rule instance anchored to a location.
+
+    ``path``/``line``/``col`` locate source findings; graph findings use
+    ``node`` (op name + node name + input chain) instead and leave the
+    location fields at their defaults.
+    """
+
+    rule: Rule
+    message: str
+    path: str = "<graph>"
+    line: int = 0
+    col: int = 0
+    node: str = ""
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def is_error(self) -> bool:
+        return self.rule.severity == "error"
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        msg = f"{loc}: {self.rule.id} [{self.rule.severity}] {self.message}"
+        if self.rule.fixit:
+            msg += f"  (fix: {self.rule.fixit})"
+        return msg
+
+    def __str__(self):
+        return self.format()
+
+
+# -- the built-in catalog ------------------------------------------------------
+# MX1xx — API compatibility
+register_rule(
+    "MX100", "error",
+    "file does not parse",
+    "fix the syntax error; nothing else can be checked until it parses")
+register_rule(
+    "MX101", "error",
+    "version-fragile JAX import",
+    "import it from mxnet_tpu.compat (the one place allowed to probe JAX "
+    "API locations)")
+register_rule(
+    "MX102", "warning",
+    "deprecated JAX API path (works today, scheduled for removal)",
+    "migrate to the stable path or add a shim in mxnet_tpu.compat")
+
+# MX2xx — traced-code hazards
+register_rule(
+    "MX201", "warning",
+    "numpy call inside a traced function (runs on host at trace time; "
+    "silently constant-folds traced values or fails on tracers)",
+    "use jax.numpy / jax.lax inside jit/shard_map/scan bodies")
+register_rule(
+    "MX202", "error",
+    "host synchronization inside a traced function",
+    "remove .item()/.tolist()/float()/int() from traced code; return the "
+    "array and read it outside the jitted function")
+register_rule(
+    "MX203", "warning",
+    "Python control flow on a possibly-traced value",
+    "use jax.lax.cond/select or jnp.where; Python `if` on a tracer raises "
+    "TracerBoolConversionError at trace time")
+
+# MX3xx — recompilation risks
+register_rule(
+    "MX301", "warning",
+    "non-hashable container for static argument",
+    "pass a tuple: static args are jit-cache keys, and unhashable or "
+    "freshly-rebuilt containers defeat or break the compile cache")
+register_rule(
+    "MX302", "warning",
+    "string formatting inside a traced function",
+    "move logging/formatting out of the traced function (or use "
+    "jax.debug.print); f-strings on tracers sync or embed shapes that "
+    "force recompiles")
+
+# MX4xx — graph verifier (Symbol.verify)
+register_rule(
+    "MX401", "error",
+    "duplicate argument name in graph",
+    "give each Variable / auto-created parameter a unique name; binding "
+    "maps arrays by name, so duplicates silently alias storage")
+register_rule(
+    "MX402", "error",
+    "shape conflict in graph",
+    "fix the op's input shapes; the error names the op and its input chain")
+register_rule(
+    "MX403", "error",
+    "dtype conflict in graph",
+    "insert an explicit cast or fix the variable dtype; implicit mixed-"
+    "dtype graphs promote silently on TPU and burn HBM")
+register_rule(
+    "MX404", "warning",
+    "unused op output (computed, never consumed, not a graph head)",
+    "drop the unused head or consume it; dead outputs still cost "
+    "compute/HBM unless XLA proves them away")
+register_rule(
+    "MX405", "warning",
+    "unreachable node in serialized graph (not on any path to a head)",
+    "prune dead nodes when editing saved symbol JSON")
+register_rule(
+    "MX406", "warning",
+    "shape/dtype underdetermined (inference incomplete before bind)",
+    "declare Variable(shape=...)/Variable(dtype=...) or pass known shapes "
+    "to verify()")
+
+# MX5xx — jaxpr auditor
+register_rule(
+    "MX501", "warning",
+    "host callback / device-to-host transfer inside compiled program",
+    "remove callbacks from the hot path; each one stalls the TPU pipeline "
+    "on a host round-trip")
+register_rule(
+    "MX502", "warning",
+    "unexpected dtype promotion in compiled program",
+    "check preferred_element_type / explicit casts; a f32 leak in a bf16 "
+    "program doubles that tensor's HBM traffic")

@@ -1,0 +1,391 @@
+"""mxlint Pass 1: AST-based source lint.
+
+Catches, before anything imports or traces:
+  MX101/MX102  version-fragile / deprecated JAX import paths (the class of
+               failure that bricked the seed: ``from jax import shard_map``
+               took out all 75 test modules at collection time),
+  MX201-203    host-sync hazards inside traced code (numpy calls, .item(),
+               float()/int() on traced values, Python branches on tracers),
+  MX301-302    recompilation risks (unhashable static-arg containers,
+               string formatting under trace).
+
+Traced-context detection is intentionally heuristic: a function counts as
+traced when it is *visibly* wired into JAX tracing — decorated with
+jit/vmap/grad/checkpoint (directly or via functools.partial), passed to a
+known tracing entry point (jit, shard_map, lax.scan/cond/while_loop/
+fori_loop/switch, custom-vjp defvjp, ...), or nested inside such a
+function. Closures that escape through variables are not chased; the lint
+favors zero false positives on error-severity rules over recall, since the
+self-lint gates the tier-1 suite (tools/run_mxlint.py).
+
+This module itself must not import jax (nor the linted files — everything
+is AST-level), keeping Pass 1 cheap and side-effect-free. Note the ``-m``
+CLI entry still pays the ``mxnet_tpu`` package import (jax is a hard
+dependency of the package); only the lint work itself is jax-free.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .rules import Finding, get_rule
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "iter_python_files"]
+
+# import path -> why it is fragile across the supported range
+FRAGILE_JAX_IMPORTS = {
+    "jax.shard_map":
+        "only exists in jax>=0.6 (lives at jax.experimental.shard_map "
+        "before that)",
+    "jax.experimental.shard_map":
+        "removed in jax>=0.7 (promoted to jax.shard_map)",
+    "jax.experimental.maps":
+        "removed in jax 0.4.31 (xmap retired)",
+    "jax.linear_util":
+        "removed in jax 0.4.24 (moved to jax.extend.linear_util)",
+    "jax.abstract_arrays":
+        "removed in jax 0.4.25 (merged into jax.core avals)",
+    "jax.experimental.host_callback":
+        "removed in jax 0.4.35 (replaced by jax.pure_callback/io_callback)",
+}
+
+DEPRECATED_JAX_IMPORTS = {
+    "jax.experimental.pjit":
+        "pjit is jax.jit since 0.4; the experimental path is slated for "
+        "removal",
+    "jax.interpreters.xla":
+        "progressively gutted since 0.4.x; most symbols have no "
+        "replacement at this path",
+}
+
+# tracing entry point -> positions of function-valued operands
+TRACING_CALLS = {
+    "jax.jit": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.vjp": (0,),
+    "jax.jvp": (0,),
+    "jax.linearize": (0,),
+    "jax.make_jaxpr": (0,),
+    "jax.eval_shape": (0,),
+    "jax.custom_vjp": (0,),
+    "jax.custom_jvp": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1, 2, 3, 4, 5),
+    "jax.shard_map": (0,),
+    "jax.experimental.shard_map.shard_map": (0,),
+    "mxnet_tpu.compat.shard_map": (0,),
+    "compat.shard_map": (0,),
+    "jax.experimental.pjit.pjit": (0,),
+}
+
+# functions passed here run on HOST even when called from traced code —
+# their bodies are exempt from the traced-code hazard rules
+CALLBACK_CALLS = {
+    "jax.pure_callback": (0,),
+    "jax.io_callback": (0,),
+    "jax.debug.callback": (0,),
+    "jax.experimental.io_callback": (0,),
+}
+
+_HOST_SYNC_ATTRS = ("item", "tolist")
+_HOST_CAST_FUNCS = ("float", "int", "bool", "complex")
+_SKIP_DIRS = {".git", "__pycache__", ".claude", ".pytest_cache", "node_modules"}
+
+
+def _dotted(expr, imports):
+    """Resolve an expression to a dotted path via the module's import map.
+    Returns None when the root name is not an imported module/symbol."""
+    parts = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = imports.get(node.id)
+    if root is None:
+        return None
+    parts.append(root)
+    return ".".join(reversed(parts))
+
+
+def _match_tracing(path):
+    """Return function-operand positions when ``path`` names a tracing
+    entry point (suffix-tolerant: 'lax.scan' matches 'jax.lax.scan')."""
+    if path is None:
+        return None
+    for key, pos in TRACING_CALLS.items():
+        if path == key or path.endswith("." + key) or key.endswith("." + path):
+            return pos
+    return None
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """One pass over the module: imports, import findings, traced roots."""
+
+    def __init__(self, path):
+        self.path = path
+        self.imports: dict[str, str] = {}
+        self.findings: list[Finding] = []
+        self.traced_names: set[str] = set()
+        self.traced_lambdas: list[ast.Lambda] = []
+        self.host_names: set[str] = set()
+        self.host_lambdas: set[int] = set()
+        self.defs: list[ast.FunctionDef] = []
+
+    # -- imports --------------------------------------------------------------
+    def _check_import_path(self, full, node):
+        for table, rule_id in ((FRAGILE_JAX_IMPORTS, "MX101"),
+                               (DEPRECATED_JAX_IMPORTS, "MX102")):
+            for banned, why in table.items():
+                if full == banned or full.startswith(banned + "."):
+                    self.findings.append(Finding(
+                        get_rule(rule_id), f"`{full}`: {why}",
+                        path=self.path, line=node.lineno,
+                        col=node.col_offset))
+                    return
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            if alias.asname:  # `import a.b as x` binds x to the full path
+                self.imports[alias.asname] = alias.name
+            else:  # `import a.b.c` binds only the root name `a`
+                root = alias.name.split(".")[0]
+                self.imports[root] = root
+            self._check_import_path(alias.name, node)
+
+    def visit_ImportFrom(self, node):
+        mod = ("." * node.level) + (node.module or "")
+        for alias in node.names:
+            full = f"{mod}.{alias.name}" if mod else alias.name
+            self.imports[alias.asname or alias.name] = full.lstrip(".")
+            self._check_import_path(full.lstrip("."), node)
+
+    # -- traced-root discovery ------------------------------------------------
+    def _mark_fn_operand(self, arg):
+        if isinstance(arg, ast.Lambda):
+            self.traced_lambdas.append(arg)
+        elif isinstance(arg, ast.Name):
+            self.traced_names.add(arg.id)
+        elif isinstance(arg, ast.Call):
+            # functools.partial(fn, ...) / jax.checkpoint(fn) wrapping
+            for inner in arg.args:
+                self._mark_fn_operand(inner)
+
+    def _mark_host_operand(self, arg):
+        if isinstance(arg, ast.Lambda):
+            self.host_lambdas.add(id(arg))
+        elif isinstance(arg, ast.Name):
+            self.host_names.add(arg.id)
+
+    def visit_Call(self, node):
+        dotted = _dotted(node.func, self.imports)
+        for key, positions in CALLBACK_CALLS.items():
+            if dotted is not None and (dotted == key
+                                       or key.endswith("." + dotted)
+                                       or dotted.endswith("." + key)):
+                for i in positions:
+                    if i < len(node.args):
+                        self._mark_host_operand(node.args[i])
+        pos = _match_tracing(dotted)
+        if pos is None and dotted is not None and \
+                dotted.endswith("partial") and any(
+                    _match_tracing(_dotted(a, self.imports)) is not None
+                    for a in node.args):
+            pos = ()  # functools.partial(jax.jit, ...): kwargs still checked
+        if pos is not None:
+            for i in pos:
+                if i < len(node.args):
+                    self._mark_fn_operand(node.args[i])
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") and \
+                        isinstance(kw.value, (ast.List, ast.Set, ast.Dict)):
+                    self.findings.append(Finding(
+                        get_rule("MX301"),
+                        f"`{kw.arg}` given a "
+                        f"{type(kw.value).__name__.lower()} literal",
+                        path=self.path, line=node.lineno,
+                        col=node.col_offset))
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "defvjp":
+            for arg in node.args:  # custom_vjp fwd/bwd pair
+                self._mark_fn_operand(arg)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.defs.append(node)
+        for dec in node.decorator_list:
+            target = dec
+            candidates = [dec]
+            if isinstance(dec, ast.Call):
+                candidates = [dec.func] + list(dec.args)
+            for target in candidates:
+                if _match_tracing(_dotted(target, self.imports)) is not None:
+                    self.traced_names.add(node.name)
+                    break
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _TracedWalk(ast.NodeVisitor):
+    """Hazard scan inside one traced root (nested defs included)."""
+
+    def __init__(self, scan: _ModuleScan, params: set[str]):
+        self.scan = scan
+        self.params = params
+
+    def _flag(self, rule_id, msg, node):
+        self.scan.findings.append(Finding(
+            get_rule(rule_id), msg, path=self.scan.path,
+            line=node.lineno, col=node.col_offset))
+
+    def visit_FunctionDef(self, node):
+        if node.name in self.scan.host_names:
+            return  # callback body: runs on host, numpy etc. is correct
+        self.params.update(a.arg for a in node.args.args
+                           if a.arg not in ("self", "cls"))
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if id(node) in self.scan.host_lambdas:
+            return
+        self.params.update(a.arg for a in node.args.args)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        dotted = _dotted(node.func, self.scan.imports)
+        if dotted is not None and (dotted == "numpy"
+                                   or dotted.startswith("numpy.")):
+            self._flag("MX201",
+                       f"`{dotted}(...)` runs on host at trace time",
+                       node)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _HOST_SYNC_ATTRS and not node.args:
+            self._flag("MX202",
+                       f"`.{node.func.attr}()` blocks on device-to-host "
+                       "transfer inside traced code", node)
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in _HOST_CAST_FUNCS and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in self.params:
+            self._flag("MX202",
+                       f"`{node.func.id}({node.args[0].id})` forces a host "
+                       "sync on a traced value", node)
+        self.generic_visit(node)
+
+    def _test_touches_param(self, test):
+        if isinstance(test, ast.Name):
+            return test.id in self.params
+        if isinstance(test, ast.Compare):
+            sides = [test.left] + list(test.comparators)
+            return any(isinstance(s, ast.Name) and s.id in self.params
+                       for s in sides)
+        if isinstance(test, ast.BoolOp):
+            return any(self._test_touches_param(v) for v in test.values)
+        return False
+
+    def visit_If(self, node):
+        if self._test_touches_param(node.test):
+            self._flag("MX203", "Python `if` on a function argument that "
+                       "may be traced", node)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self._test_touches_param(node.test):
+            self._flag("MX203", "Python `while` on a function argument "
+                       "that may be traced", node)
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        self._flag("MX302", "f-string inside traced code", node)
+        # no generic_visit: one finding per f-string
+
+
+def _suppressed(finding: Finding, lines: list[str]) -> bool:
+    if not 1 <= finding.line <= len(lines):
+        return False
+    line = lines[finding.line - 1]
+    if "# mxlint:" not in line:
+        return False
+    pragma = line.split("# mxlint:", 1)[1].strip()
+    if pragma.startswith("disable"):
+        _, _, ids = pragma.partition("=")
+        if not ids.strip():
+            return True
+        return finding.rule.id in {i.strip() for i in ids.split(",")}
+    return False
+
+
+def lint_source(text: str, path: str = "<string>") -> list[Finding]:
+    """Lint one Python source string; returns findings (pragma-filtered)."""
+    lines = text.splitlines()
+    if any("# mxlint: skip-file" in ln for ln in lines[:5]):
+        return []
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        f = Finding(get_rule("MX100"),
+                    f"file does not parse: {e.msg}", path=path,
+                    line=e.lineno or 0, col=e.offset or 0)
+        return [f]
+
+    scan = _ModuleScan(path)
+    scan.visit(tree)
+
+    roots: list[ast.AST] = list(scan.traced_lambdas)
+    roots += [d for d in scan.defs if d.name in scan.traced_names]
+    visited: set[int] = set()
+    for root in roots:
+        if id(root) in visited:
+            continue
+        for sub in ast.walk(root):
+            visited.add(id(sub))
+        args = root.args
+        params = {a.arg for a in args.args if a.arg not in ("self", "cls")}
+        params.update(a.arg for a in args.kwonlyargs)
+        _TracedWalk(scan, params).visit(
+            root if isinstance(root, ast.Lambda) else ast.Module(
+                body=root.body, type_ignores=[]))
+
+    return [f for f in scan.findings if not _suppressed(f, lines)]
+
+
+def lint_file(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def iter_python_files(paths):
+    """Expand files/directories into .py files, deterministic order."""
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+        else:
+            yield p
+
+
+def lint_paths(paths) -> list[Finding]:
+    findings = []
+    for f in iter_python_files(paths):
+        if f.endswith(".py"):
+            findings.extend(lint_file(f))
+    return findings

@@ -367,6 +367,19 @@ class Executor:
         for n in arg_names:
             self.grad_req.setdefault(n, "null")
 
+        # pre-bind graph verification (mxlint Pass 2; reference:
+        # StaticGraph::InferShape runs before GraphExecutor binds): full
+        # shape+dtype inference and structural checks against the actual
+        # bound buffers, so conflicts fail HERE with the op named instead
+        # of deep inside XLA tracing. MXNET_TPU_VERIFY=0 disables.
+        from .base import env_bool
+
+        if env_bool("MXNET_TPU_VERIFY", True):
+            symbol.verify(
+                arg_shapes={n: tuple(a.shape)
+                            for n, a in self.arg_dict.items()},
+                arg_dtypes={n: a.dtype for n, a in self.arg_dict.items()})
+
         self._fwd_fns = {}  # is_train -> jitted fn
         self._bwd_fn = None
         self._outputs: list[NDArray] | None = None

@@ -165,7 +165,9 @@ def _maybe_init_distributed():
     rank = os.environ.get("MXTPU_WORKER_RANK")
     if not coord or nproc <= 1 or rank is None:
         return
-    if jax.distributed.is_initialized():
+    from .compat import distributed_initialized
+
+    if distributed_initialized():
         return  # caller already joined the world themselves
     try:
         jax.distributed.initialize(coord, num_processes=nproc,

@@ -17,8 +17,10 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..compat import shard_map
 
 from ..parallel.expert import moe_ffn
 from ..parallel.pipeline import spmd_pipeline
@@ -150,23 +152,37 @@ class MoEPipelineLM:
                             preferred_element_type=jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        local = jnp.where(pp_idx == pp_size - 1, jnp.sum(nll), 0.0)
-        count = jnp.asarray(mb_total * seq, jnp.float32)
-        total = lax.psum(local, ("pp", "dp", "ep"))
-        n = lax.psum(jnp.where(pp_idx == pp_size - 1, count, 0.0),
-                     ("pp", "dp", "ep"))
+        # rank-2 mask, not a scalar where(): any scalar saved for backward
+        # trips the same residual mis-spec
+        is_last = (pp_idx == pp_size - 1).astype(nll.dtype).reshape(1, 1)
+        total = lax.psum(jnp.sum(nll * is_last).reshape(1, 1),
+                         ("pp", "dp", "ep"))
+        # token count is static (axis sizes and shard shapes are known at
+        # trace time): each (dp, ep) shard's last pp stage contributes
+        # mb_total*seq tokens. Folding it to a Python float keeps scalar
+        # tensors out of the shard_map residual set — jax 0.4.x mis-specs
+        # unpromoted scalar residuals in the grad transpose (_SpecError).
+        dp_size = lax.psum(1, "dp")
+        ep_size = lax.psum(1, "ep")
+        n = float(mb_total * seq * dp_size * ep_size)
         # Switch load-balance aux: summed over stages (one MoE per stage),
         # averaged over microbatches and data shards
-        aux = lax.pmean(lax.psum(aux_sum / n_micro, "pp"), ("dp", "ep"))
+        aux = lax.pmean(lax.psum(aux_sum.reshape(1, 1) / n_micro, "pp"),
+                        ("dp", "ep"))
         return total / n + cfg["aux_loss_coef"] * aux
 
     def loss(self, mesh: Mesh, params, tokens, targets):
         specs = self._param_specs()
         data = P(("dp", "ep"), None)
+        # the per-shard loss stays rank-2 all the way out (out_specs
+        # P(None, None)) and is squeezed here, outside the shard_map:
+        # scalars crossing the shard_map boundary — outputs or saved
+        # residuals — hit the jax 0.4.x unpromoted-scalar-residual bug
+        # under grad (see _sharded_loss tail)
         fn = shard_map(self._sharded_loss, mesh=mesh,
-                       in_specs=(specs, data, data), out_specs=P(),
-                       check_vma=False)
-        return fn(params, tokens, targets)
+                       in_specs=(specs, data, data),
+                       out_specs=P(None, None), check_vma=False)
+        return fn(params, tokens, targets).reshape(())
 
     def make_train_step(self, mesh: Mesh, lr=0.1, momentum=0.9):
         pshard = self.param_shardings(mesh)

@@ -177,7 +177,7 @@ class TransformerLM:
                     # pallas_call has no GSPMD partitioning rule; run the
                     # kernel per-shard over (dp, tp) via shard_map so the
                     # sharded train step keeps its partitioning.
-                    from jax import shard_map
+                    from ..compat import shard_map
                     spec = P("dp", "tp", None, None)
                     attn = shard_map(
                         functools.partial(flash_attention, causal=True),

@@ -95,6 +95,39 @@ class OpProp:
             )
         return tuple(s)
 
+    # -- dtype inference ------------------------------------------------------
+    def infer_dtype(self, in_dtypes):
+        """Complete partial input dtypes; return (in, out, aux) dtype lists.
+
+        Mirrors ``infer_shape`` (reference: OperatorProperty::InferType).
+        The default propagates the first known input dtype everywhere and
+        requires the known inputs to agree — except loss-head ``label``
+        inputs, whose dtype is independent of the data path (int class ids
+        against float logits is the normal case). Ops with genuinely
+        heterogeneous inputs (Embedding: int ids + float table) override.
+        """
+        import numpy as np
+
+        args = self.list_arguments()
+        known = [(i, np.dtype(d)) for i, d in enumerate(in_dtypes)
+                 if d is not None]
+        if not known:
+            raise MXNetError(f"{self.name}: no input dtype known")
+        d = known[0][1]
+        for i, dt in known:
+            if self.is_loss and args[i] == "label":
+                continue
+            if dt != d:
+                raise MXNetError(
+                    f"{self.name}: input '{args[i]}' has dtype {dt} but "
+                    f"'{args[known[0][0]]}' has dtype {d}")
+        completed = [
+            (np.dtype(in_dtypes[i]) if in_dtypes[i] is not None else d)
+            for i in range(len(in_dtypes))
+        ]
+        return (completed, [d] * self.num_outputs(),
+                [d] * len(self.list_auxiliary_states()))
+
     # -- kernel ---------------------------------------------------------------
     def fwd(self, ins, aux, is_train, rng):
         raise NotImplementedError

@@ -325,6 +325,16 @@ class EmbeddingOp(OpProp):
         w = (self.input_dim, self.output_dim)
         return [d, w], [d + (self.output_dim,)], []
 
+    def infer_dtype(self, in_dtypes):
+        # heterogeneous by design: data is integer token ids, the output
+        # follows the embedding table's float dtype
+        import numpy as np
+
+        data, weight = in_dtypes
+        w = np.dtype(weight) if weight is not None else np.dtype("float32")
+        d = np.dtype(data) if data is not None else np.dtype("int32")
+        return [d, w], [w], []
+
     def fwd(self, ins, aux, is_train, rng):
         data, weight = ins
         return [jnp.take(weight, data.astype(jnp.int32), axis=0)], []

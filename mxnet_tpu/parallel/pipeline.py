@@ -56,7 +56,10 @@ def spmd_pipeline(block_fn, n_micro: int, axis_name: str = "pp",
                 y, aux = block_fn(stage_params, cur)
                 # stage idx holds real data at ticks [idx, idx + n_micro)
                 real = jnp.logical_and(t >= idx, t < idx + n_micro)
-                aux_sum = aux_sum + jnp.where(real, aux, 0.0)
+                # rank-2 accumulator: a scalar scan carry becomes a scalar
+                # residual at the enclosing shard_map boundary, which jax
+                # 0.4.x fails to promote in the grad transpose (_SpecError)
+                aux_sum = aux_sum + jnp.where(real, aux, 0.0).reshape(1, 1)
             else:
                 y = block_fn(stage_params, cur)
             # last stage emits microbatch t-(p-1) once the pipe is full
@@ -75,7 +78,8 @@ def spmd_pipeline(block_fn, n_micro: int, axis_name: str = "pp",
         cur0 = jnp.zeros(mb_shape, x_micro.dtype)
         outs0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
         (cur, outs, aux_sum), _ = lax.scan(
-            tick, (cur0, outs0, jnp.float32(0.0)), jnp.arange(ticks))
-        return (outs, aux_sum) if with_aux else outs
+            tick, (cur0, outs0, jnp.zeros((1, 1), jnp.float32)),
+            jnp.arange(ticks))
+        return (outs, aux_sum.reshape(())) if with_aux else outs
 
     return run

@@ -206,8 +206,9 @@ def ring_self_attention(mesh, q, k, v, causal=False, use_flash=False):
     """Convenience wrapper: shard_map ring attention over mesh axis 'sp',
     with batch on 'dp' and heads on 'tp'. ``use_flash`` routes the per-block
     math through the Pallas flash kernels (ring_flash_attention)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..compat import shard_map
 
     spec = P("dp", "tp", "sp", None)
     if use_flash:

@@ -28,13 +28,15 @@ __all__ = ["Symbol", "Variable", "Group", "load", "load_json"]
 
 
 class _Node:
-    __slots__ = ("op", "name", "inputs", "declared_shape")
+    __slots__ = ("op", "name", "inputs", "declared_shape", "declared_dtype")
 
-    def __init__(self, op: OpProp | None, name: str, inputs, declared_shape=None):
+    def __init__(self, op: OpProp | None, name: str, inputs,
+                 declared_shape=None, declared_dtype=None):
         self.op = op  # None => variable node
         self.name = name
         self.inputs = inputs  # list of (Node, out_index)
         self.declared_shape = declared_shape  # optional, for variables
+        self.declared_dtype = declared_dtype  # optional, for variables
 
     @property
     def is_variable(self):
@@ -210,6 +212,39 @@ class Symbol:
         except MXNetError:
             return None, None, None
 
+    # -- pre-bind verification (reference: StaticGraph::InferShape) -----------
+    def verify(self, arg_shapes=None, arg_dtypes=None, raise_on_error=True,
+               **shape_kwargs):
+        """Static pre-bind verification of the whole graph (mxlint Pass 2).
+
+        Runs full shape AND dtype inference over the node DAG plus
+        structural checks (duplicate argument names, unused outputs),
+        reporting every problem with the offending op name and its input
+        chain — the ``StaticGraph::InferShape`` contract, extended to
+        dtypes. Invoked automatically on ``bind`` with the bound arrays'
+        shapes/dtypes (disable: MXNET_TPU_VERIFY=0).
+
+        ``arg_shapes``/``arg_dtypes``: dicts name -> shape/dtype for (a
+        subset of) the arguments; shapes may also be passed as kwargs like
+        ``infer_shape``. Variable-declared shapes/dtypes fill the rest.
+
+        Returns the full finding list (warnings included); raises
+        MXNetError listing every error-grade finding unless
+        ``raise_on_error=False``.
+        """
+        from .analysis.graph import verify_symbol
+
+        shapes = dict(arg_shapes or {})
+        shapes.update(shape_kwargs)
+        findings = verify_symbol(self, shapes or None, arg_dtypes)
+        errors = [f for f in findings if f.is_error]
+        if errors and raise_on_error:
+            raise MXNetError(
+                "Symbol.verify failed with "
+                f"{len(errors)} error(s):\n  "
+                + "\n  ".join(f.format() for f in errors))
+        return findings
+
     # -- serialization (reference: Symbol::Save/Load JSON) --------------------
     def tojson(self) -> str:
         nodes = self._topo()
@@ -266,15 +301,17 @@ class Symbol:
         return simple_bind(self, ctx, grad_req, **input_shapes)
 
 
-def Variable(name, shape=None) -> Symbol:
+def Variable(name, shape=None, dtype=None) -> Symbol:
     """A named input/parameter placeholder (reference: Symbol::CreateVariable).
 
-    ``shape`` (extension) declares the variable's shape so graph-wide
-    ``infer_shape`` can use it without the caller re-passing it."""
+    ``shape``/``dtype`` (extensions) declare the variable's shape and dtype
+    so graph-wide ``infer_shape`` / ``verify`` can use them without the
+    caller re-passing them."""
     if not isinstance(name, str):
         raise TypeError("Variable name must be str")
     return Symbol([(_Node(None, name, [],
-                          declared_shape=tuple(shape) if shape else None), 0)])
+                          declared_shape=tuple(shape) if shape else None,
+                          declared_dtype=dtype), 0)])
 
 
 def Group(symbols) -> Symbol:

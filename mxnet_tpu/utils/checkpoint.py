@@ -117,7 +117,11 @@ def load_sharded(directory, step=None, shardings=None):
         # Explicit numpy restore args: without them orbax restores with the
         # *saved* shardings and warns that this is unsafe across topologies —
         # the host-numpy default must not depend on the saving mesh.
-        meta_tree = ckptr.metadata(state_path).item_metadata.tree
+        # orbax API drift: metadata() returns the metadata tree directly
+        # (a dict, older orbax) or wraps it as .item_metadata.tree (newer)
+        meta_tree = ckptr.metadata(state_path)
+        meta_tree = getattr(meta_tree, "item_metadata", meta_tree)
+        meta_tree = getattr(meta_tree, "tree", meta_tree)
         restore_args = jax.tree_util.tree_map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree)
     state = ckptr.restore(state_path, restore_args=restore_args)

@@ -1,0 +1,261 @@
+"""mxlint tier: seeded violations produce exactly the expected rule ids,
+the repo itself lints clean (THE self-lint gate: this test runs in tier-1
+on every PR), and Symbol.verify enforces the StaticGraph::InferShape
+contract at bind time (ISSUE 1 acceptance criteria)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.analysis import lint_source, verify_json, verify_symbol
+from mxnet_tpu.base import MXNetError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ids(findings):
+    return [f.rule.id for f in findings]
+
+
+# -- Pass 1: source lint fixtures ---------------------------------------------
+
+def test_fixture_syntax_error_is_mx100():
+    findings = lint_source("def broken(:\n", "fx.py")
+    assert _ids(findings) == ["MX100"]
+    assert findings[0].is_error
+
+
+def test_fixture_bad_import():
+    findings = lint_source("from jax import shard_map\n", "fx.py")
+    assert _ids(findings) == ["MX101"]
+    assert findings[0].is_error
+
+
+def test_fixture_bad_import_experimental_path():
+    src = "from jax.experimental.shard_map import shard_map\n"
+    assert _ids(lint_source(src, "fx.py")) == ["MX101"]
+
+
+def test_fixture_item_in_jitted_fn():
+    src = (
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+    )
+    findings = lint_source(src, "fx.py")
+    assert _ids(findings) == ["MX202"]
+    assert findings[0].line == 5
+
+
+def test_fixture_host_sync_via_tracing_call():
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def body(c, x):\n"
+        "    return c + float(x), None\n"
+        "def run(xs):\n"
+        "    return lax.scan(body, 0.0, xs)\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == ["MX202"]
+
+
+def test_fixture_numpy_in_shard_map_body():
+    src = (
+        "import numpy as np\n"
+        "from mxnet_tpu.compat import shard_map\n"
+        "def block(x):\n"
+        "    return np.sum(x)\n"
+        "def run(mesh, spec, x):\n"
+        "    return shard_map(block, mesh=mesh, in_specs=spec,\n"
+        "                     out_specs=spec)(x)\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == ["MX201"]
+
+
+def test_fixture_static_argnums_list():
+    src = (
+        "import jax\n"
+        "def g(x, n):\n"
+        "    return x\n"
+        "h = jax.jit(g, static_argnums=[1])\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == ["MX301"]
+
+
+def test_fixture_fstring_in_traced_fn():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    name = f'shape={x.shape}'\n"
+        "    return x\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == ["MX302"]
+
+
+def test_callback_bodies_are_exempt():
+    """numpy inside a pure_callback host fn is correct, not a hazard."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    def cb(a):\n"
+        "        return np.asarray(a) * 2\n"
+        "    return jax.pure_callback(cb, x, x)\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+
+
+def test_pragma_suppression():
+    src = "from jax import shard_map  # mxlint: disable=MX101\n"
+    assert lint_source(src, "fx.py") == []
+    src2 = "# mxlint: skip-file\nfrom jax import shard_map\n"
+    assert lint_source(src2, "fx.py") == []
+    # pragma for a different rule does NOT suppress
+    src3 = "from jax import shard_map  # mxlint: disable=MX202\n"
+    assert _ids(lint_source(src3, "fx.py")) == ["MX101"]
+
+
+# -- Pass 2: graph verifier fixtures ------------------------------------------
+
+def test_fixture_duplicate_argument():
+    g = mx.sym.Variable("x") + mx.sym.Variable("x")
+    findings = [f for f in verify_symbol(g, {"x": (2, 2)}) if f.is_error]
+    assert _ids(findings) == ["MX401"]
+    with pytest.raises(MXNetError, match="MX401"):
+        g.verify(arg_shapes={"x": (2, 2)})
+
+
+def test_fixture_shape_conflict():
+    data = mx.sym.Variable("data")
+    fc = mx.symbol.FullyConnected(data=data, num_hidden=3, name="fc1")
+    bad = fc + data  # (4,3) + (4,5)
+    findings = [f for f in verify_symbol(bad, {"data": (4, 5)})
+                if f.is_error]
+    assert _ids(findings) == ["MX402"]
+    msg = findings[0].message
+    assert "_Plus" in msg and "input chain" in msg  # op + chain named
+    with pytest.raises(MXNetError, match="MX402"):
+        bad.verify(arg_shapes={"data": (4, 5)})
+
+
+def test_fixture_dtype_conflict():
+    lhs = mx.sym.Variable("l", shape=(2, 2), dtype=np.float32)
+    rhs = mx.sym.Variable("r", shape=(2, 2), dtype=np.float16)
+    with pytest.raises(MXNetError, match="MX403"):
+        (lhs + rhs).verify()
+
+
+def test_embedding_mixed_dtypes_allowed():
+    """Embedding is heterogeneous by design: int ids + float table."""
+    emb = mx.symbol.Embedding(data=mx.sym.Variable("tokens"),
+                              input_dim=16, output_dim=4, name="emb")
+    findings = emb.verify(arg_shapes={"tokens": (2, 8)},
+                          arg_dtypes={"tokens": np.int32,
+                                      "emb_weight": np.float32})
+    assert not [f for f in findings if f.is_error]
+
+
+def test_unused_output_warning():
+    split = mx.symbol.SliceChannel(mx.sym.Variable("data"), num_outputs=2,
+                                   name="split")
+    one_head = split[0]  # output 1 computed, never consumed
+    findings = one_head.verify(arg_shapes={"data": (4, 6)})
+    assert "MX404" in _ids(findings)
+    assert not [f for f in findings if f.is_error]  # warning only
+
+
+def test_unreachable_node_in_json():
+    net = mx.symbol.FullyConnected(data=mx.sym.Variable("data"),
+                                   num_hidden=3, name="fc1")
+    import json
+
+    graph = json.loads(net.tojson())
+    graph["nodes"].append({"op": "null", "name": "orphan", "inputs": []})
+    findings = verify_json(json.dumps(graph))
+    assert "MX405" in _ids(findings)
+
+
+def test_verify_runs_on_bind():
+    """Acceptance: bind invokes verify automatically and names the node."""
+    import mxnet_tpu.ndarray as nd
+
+    net = mx.symbol.FullyConnected(data=mx.sym.Variable("data"),
+                                   num_hidden=3, name="fc1")
+    args = {"data": nd.zeros((4, 5)), "fc1_weight": nd.zeros((3, 9)),
+            "fc1_bias": nd.zeros((3,))}
+    with pytest.raises(MXNetError) as ei:
+        net.bind(mx.cpu(), args)
+    assert "fc1" in str(ei.value) and "MX402" in str(ei.value)
+    # the env gate turns it off (failure then happens later, at trace)
+    os.environ["MXNET_TPU_VERIFY"] = "0"
+    try:
+        net.bind(mx.cpu(), args)  # bind itself now succeeds
+    finally:
+        del os.environ["MXNET_TPU_VERIFY"]
+
+
+# -- Pass 3: jaxpr audit ------------------------------------------------------
+
+def test_jaxpr_audit_costs_and_promotion():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.analysis import audit_executor, audit_jaxpr
+
+    net = mx.symbol.FullyConnected(data=mx.sym.Variable("data"),
+                                   num_hidden=8, name="fc1")
+    exe = net.simple_bind(mx.cpu(), data=(16, 32))
+    rep = audit_executor(exe)
+    assert not rep.errors
+    by_prim = {r["primitive"]: r for r in rep.rows}
+    # FC = x@W.T + b: 2*M*N*K MACs-as-flops
+    assert by_prim["dot_general"]["flops"] == 2 * 16 * 32 * 8
+    assert rep.totals["bytes"] > 0
+
+    def leaky(x):
+        return x.astype(jnp.float32) * 2.0
+
+    closed = jax.make_jaxpr(leaky)(jnp.ones((4, 4), jnp.bfloat16))
+    rep2 = audit_jaxpr(closed, intended_dtype=jnp.bfloat16)
+    assert "MX502" in [f.rule.id for f in rep2.findings]
+
+
+# -- the self-lint gate -------------------------------------------------------
+
+def test_self_lint_package_clean():
+    """mxlint over mxnet_tpu/ itself: zero errors (warnings allowed)."""
+    from mxnet_tpu.analysis import lint_paths
+
+    findings = lint_paths([os.path.join(REPO, "mxnet_tpu")])
+    errors = [f for f in findings if f.is_error]
+    assert not errors, "\n".join(f.format() for f in errors)
+
+
+@pytest.mark.parametrize("target,expect_ok", [
+    (os.path.join(REPO, "mxnet_tpu"), True),
+    (None, False),  # seeded violation file, built in the test
+])
+def test_cli_exit_codes(tmp_path, target, expect_ok):
+    """Acceptance: `python -m mxnet_tpu.analysis mxnet_tpu/` exits 0; a
+    seeded violation makes it exit non-zero with the rule id printed."""
+    if target is None:
+        bad = tmp_path / "seeded.py"
+        bad.write_text("from jax import shard_map\n")
+        target = str(bad)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", target],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=240)
+    if expect_ok:
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    else:
+        assert proc.returncode == 1
+        assert "MX101" in proc.stdout

@@ -26,7 +26,7 @@ def test_mesh_wrong_size():
 
 
 def test_allreduce_grads_shard_map():
-    from jax import shard_map
+    from mxnet_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = par.make_mesh(dp=8)
@@ -65,7 +65,7 @@ def test_dp_training_equivalence():
 
 
 def test_ring_attention_matches_dense():
-    from jax import shard_map
+    from mxnet_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
     from mxnet_tpu.parallel.sequence import attention_reference, ring_attention
     import functools
@@ -147,7 +147,7 @@ def test_ring_flash_attention_matches_dense(causal):
     ring, backward through per-block flash kernels vs global lse)."""
     import functools as ft
 
-    from jax import shard_map
+    from mxnet_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from mxnet_tpu.parallel import make_mesh

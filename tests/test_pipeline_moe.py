@@ -9,7 +9,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import lax, shard_map
+from jax import lax
+
+from mxnet_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from mxnet_tpu.parallel import make_mesh

@@ -277,6 +277,10 @@ def main():
                     help="apply MXNET_TPU_REMAT before compiling, to "
                          "compare saved-activation traffic vs the inline "
                          "step (bare --remat = ResNet unit boundaries)")
+    ap.add_argument("--jaxpr-table", action="store_true",
+                    help="also print mxlint Pass-3 per-primitive FLOP/byte "
+                         "totals from the pre-fusion jaxpr (brackets the "
+                         "HLO table from the unfused side)")
     args = ap.parse_args()
 
     import os
@@ -311,6 +315,17 @@ def main():
               f"{r['name']}{src}")
     print("traffic by opcode:",
           {k: round(v, 2) for k, v in list(op_totals.items())[:8]})
+
+    if args.jaxpr_table:
+        from mxnet_tpu.analysis import cost_rows
+
+        rows, totals = cost_rows(step, params, moms, aux, data, label)
+        print(f"jaxpr (pre-fusion): {totals['eqns']} eqns, "
+              f"{totals['flops']/1e9:.2f} GFLOP, "
+              f"{totals['bytes']/1e9:.2f} GB unfused operand+output bytes")
+        for r in rows[:15]:
+            print(f"  {r['bytes']/1e9:7.3f} GB  {r['flops']/1e9:8.3f} GF  "
+                  f"{r['primitive']:<24} x{r['count']}")
 
     if args.analyze_only:
         out = {

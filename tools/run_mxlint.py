@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Thin wrapper over ``python -m mxnet_tpu.analysis`` (mxlint).
+
+Exists so CI recipes and humans have a stable entry point that works from
+any cwd: it pins the repo root on sys.path, defaults to linting the
+package plus the tools and tests trees, and passes everything else
+through to the real CLI (see doc/developer-guide/static_analysis.md).
+
+The tier-1 wiring is tests/test_mxlint.py::test_self_lint_package_clean /
+test_cli_exit_codes — every `pytest tests/` run self-lints the repo, no
+external CI needed. This wrapper is the same gate for hook/manual use:
+
+    python tools/run_mxlint.py              # lint the default trees
+    python tools/run_mxlint.py mxnet_tpu    # or any explicit paths
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu.analysis import main  # noqa: E402
+from mxnet_tpu.analysis.__main__ import _parser  # noqa: E402
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    # use the real parser to decide whether positional paths were given —
+    # a naive "starts with -" scan misreads flag values like --select MX101
+    if not _parser().parse_args(argv).paths:
+        argv = [os.path.join(REPO, "mxnet_tpu"),
+                os.path.join(REPO, "tools"),
+                os.path.join(REPO, "tests")] + argv
+    sys.exit(main(argv))
