@@ -16,6 +16,10 @@ Layering (cf. SURVEY.md §1):
                              probe fragile API locations; mxlint MX101)
   analysis/                - mxlint: source lint, Symbol.verify graph pass,
                              jaxpr audit (doc/developer-guide/static_analysis.md)
+  resilience/              - fault tolerance: chaos injection, retrying
+                             kvstore transport + circuit breaker, step
+                             guards/watchdog, preemption-safe checkpoints
+                             (doc/developer-guide/resilience.md)
 """
 
 # Join the jax.distributed world BEFORE anything touches a backend: under
@@ -88,5 +92,6 @@ from . import utils
 from . import predictor as _predictor_mod
 from .predictor import Predictor
 from . import analysis
+from . import resilience
 
 __version__ = "0.1.0"

@@ -13,6 +13,7 @@ Rule id bands:
   MX3xx  recompilation risks (static-arg hashing, f-strings under trace)
   MX4xx  graph verifier (Symbol.verify: shapes, dtypes, names, dead code)
   MX5xx  jaxpr auditor (host transfers, dtype promotions)
+  MX6xx  robustness (bare excepts, unbounded retry loops)
 
 Severities: ``error`` fails the CLI (exit 1) and makes ``Symbol.verify``
 raise; ``warning`` is reported but non-fatal; ``info`` is advisory output.
@@ -181,3 +182,16 @@ register_rule(
     "unexpected dtype promotion in compiled program",
     "check preferred_element_type / explicit casts; a f32 leak in a bf16 "
     "program doubles that tensor's HBM traffic")
+
+# MX6xx — robustness (ISSUE 2: the failure modes that take down real runs)
+register_rule(
+    "MX601", "error",
+    "bare `except:` swallows KeyboardInterrupt/SystemExit and masks the "
+    "real failure",
+    "catch a concrete exception type (at minimum `except Exception:`)")
+register_rule(
+    "MX602", "error",
+    "unbounded retry loop: `while True` swallowing exceptions with no "
+    "backoff, deadline, or attempt bound",
+    "use resilience.retry.retry_call / RetryPolicy (bounded retries, "
+    "exponential backoff + jitter), or add a sleep/deadline to the loop")

@@ -7,7 +7,12 @@ Catches, before anything imports or traces:
   MX201-203    host-sync hazards inside traced code (numpy calls, .item(),
                float()/int() on traced values, Python branches on tracers),
   MX301-302    recompilation risks (unhashable static-arg containers,
-               string formatting under trace).
+               string formatting under trace),
+  MX601-602    robustness hazards (bare ``except:``; ``while True`` retry
+               loops that swallow exceptions with no backoff/deadline —
+               the loop shape that melts a parameter server under a
+               partial outage; resilience.retry.RetryPolicy is the
+               sanctioned alternative).
 
 Traced-context detection is intentionally heuristic: a function counts as
 traced when it is *visibly* wired into JAX tracing — decorated with
@@ -315,6 +320,76 @@ class _TracedWalk(ast.NodeVisitor):
         # no generic_visit: one finding per f-string
 
 
+# calls whose presence inside a retry loop counts as bounding it: anything
+# sleep/backoff/wait-shaped (time.sleep, policy backoff, cv.wait_for, ...)
+_BOUNDING_CALL_PARTS = ("sleep", "backoff", "wait", "delay", "retry_call",
+                        "monotonic", "deadline")
+
+
+def _is_bounding_call(node: ast.Call) -> bool:
+    name = None
+    if isinstance(node.func, ast.Attribute):
+        name = node.func.attr
+    elif isinstance(node.func, ast.Name):
+        name = node.func.id
+    return name is not None and \
+        any(part in name.lower() for part in _BOUNDING_CALL_PARTS)
+
+
+def _handler_escapes(handler: ast.ExceptHandler) -> bool:
+    """True when the handler leaves the loop (raise/return/break at its
+    top level) — that's failure propagation, not a retry."""
+    return any(isinstance(s, (ast.Raise, ast.Return, ast.Break))
+               for s in handler.body)
+
+
+def _handler_is_swallow(handler: ast.ExceptHandler) -> bool:
+    """True when the handler does nothing but spin: only pass/continue/
+    logging — the shape of a blind retry. Handlers doing real work (e.g.
+    replying on a socket) are an event loop, not a retry loop."""
+    for s in handler.body:
+        if isinstance(s, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            f = s.value.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                getattr(f, "id", "")
+            if name in ("debug", "info", "warning", "error", "exception",
+                        "print", "log"):
+                continue
+        return False
+    return True
+
+
+def _scan_robustness(tree: ast.AST, path: str, findings: list):
+    """MX601 bare excepts; MX602 unbounded retry loops (while True +
+    exception-swallowing handler + no sleep/backoff/deadline in the loop)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(Finding(
+                get_rule("MX601"), "bare `except:` clause",
+                path=path, line=node.lineno, col=node.col_offset))
+        if isinstance(node, ast.While) and \
+                isinstance(node.test, ast.Constant) and node.test.value is True:
+            bounded = any(isinstance(sub, ast.Call) and _is_bounding_call(sub)
+                          for sub in ast.walk(node))
+            if bounded:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Try):
+                    retrying = [h for h in sub.handlers
+                                if not _handler_escapes(h)
+                                and _handler_is_swallow(h)]
+                    if retrying:
+                        findings.append(Finding(
+                            get_rule("MX602"),
+                            "`while True` retry loop swallows exceptions "
+                            "with no backoff/deadline/attempt bound",
+                            path=path, line=node.lineno,
+                            col=node.col_offset))
+                        break
+
+
 def _suppressed(finding: Finding, lines: list[str]) -> bool:
     if not 1 <= finding.line <= len(lines):
         return False
@@ -345,6 +420,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
 
     scan = _ModuleScan(path)
     scan.visit(tree)
+    _scan_robustness(tree, path, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)
     roots += [d for d in scan.defs if d.name in scan.traced_names]

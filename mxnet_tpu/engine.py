@@ -25,7 +25,9 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time as _time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
 
 from .base import MXNetError, env_int, env_str
 
@@ -123,22 +125,54 @@ class Engine:
     def push_sync(self, fn, read_vars=(), write_vars=()):
         return self.push(fn, read_vars, write_vars).result()
 
-    def wait_for_var(self, var: Var):
+    def wait_for_var(self, var: Var, timeout=None):
+        """Block until every task touching ``var`` completed. ``timeout``
+        (seconds, for the WHOLE wait) raises MXNetError on expiry —
+        host-side work (checkpoint writes, kvstore syncs) hanging past a
+        deadline must surface instead of wedging the train loop
+        (resilience: the preemption flush runs under a grace window)."""
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._lock:
             waits = list(var._readers)
             if var._tail is not None:
                 waits.append(var._tail)
         for f in waits:
-            f.result()  # re-raises task exceptions
+            try:
+                f.result(self._remaining(deadline, var))  # re-raises task errors
+            except _FutureTimeout:
+                # on py3.11+ futures.TimeoutError IS builtin TimeoutError,
+                # so a task's own timeout lands here too — only claim the
+                # deadline when OUR deadline actually expired
+                if deadline is not None and _time.monotonic() >= deadline:
+                    raise MXNetError(
+                        f"engine wait for {var} exceeded deadline") from None
+                raise
 
-    def wait_for_all(self):
+    def wait_for_all(self, timeout=None):
+        deadline = None if timeout is None else _time.monotonic() + timeout
         while True:
             with self._lock:
                 pending = [f for f in self._inflight if not f.done()]
             if not pending:
                 return
             for f in pending:
-                f.result()
+                try:
+                    f.result(self._remaining(deadline, "all tasks"))
+                except _FutureTimeout:
+                    if deadline is not None and \
+                            _time.monotonic() >= deadline:
+                        raise MXNetError(
+                            "engine wait_for_all exceeded deadline") from None
+                    raise
+
+    @staticmethod
+    def _remaining(deadline, what):
+        if deadline is None:
+            return None
+        left = deadline - _time.monotonic()
+        if left <= 0:
+            raise MXNetError(f"engine wait for {what} exceeded deadline")
+        return left
 
     def delete_variable(self, var: Var):
         # jax.Array lifetimes are GC-managed; nothing to reclaim eagerly.

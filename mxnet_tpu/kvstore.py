@@ -284,7 +284,14 @@ def wrap_np_updater(updater):
 class _GroupServer:
     """In-process BSP server for emulated multi-worker groups: accumulates
     pushes per key until all workers arrived, runs the updater once, then
-    releases pullers (reference: KVStoreDistServer::DataHandle sync path)."""
+    releases pullers (reference: KVStoreDistServer::DataHandle sync path).
+
+    Idempotent against retry resends (ISSUE 2): a worker identifying its
+    pushes with ``(worker, seq)`` can resend after a lost ack without
+    double-counting — a duplicate parks until the round it already
+    contributed to is released, then returns like the original would have.
+    Anonymous pushes (no worker id) keep the legacy accumulate-everything
+    semantics."""
 
     def __init__(self, num_workers):
         self.num_workers = num_workers
@@ -295,6 +302,9 @@ class _GroupServer:
         self._accum: dict = {}
         self._count: dict = {}
         self._round: dict = {}
+        self._contrib: dict = {}  # key -> {worker ids in the open round}
+        self._applied: dict = {}  # (key, worker) -> (seq, round applied in)
+        self.duplicate_count = 0
         self._barrier_count = 0
         self._barrier_round = 0
 
@@ -303,9 +313,30 @@ class _GroupServer:
             if key not in self.store:
                 self.store[key] = np.array(value, np.float32)
 
-    def push(self, key, value: np.ndarray):
+    def push(self, key, value: np.ndarray, worker=None, seq=None):
         with self.cv:
             my_round = self._round.get(key, 0)
+            if worker is not None:
+                prev = self._applied.get((key, worker))
+                if prev is not None and seq is not None and \
+                        prev[0] is not None and seq <= prev[0]:
+                    # resend of a push that already landed (possibly in a
+                    # completed round): wait for ITS round, not the open one
+                    self.duplicate_count += 1
+                    applied_round = prev[1]
+                    self.cv.wait_for(
+                        lambda: self._round.get(key, 0) > applied_round)
+                    return
+                contrib = self._contrib.setdefault(key, set())
+                if worker in contrib:
+                    # same-round duplicate without a usable seq: already
+                    # counted; park until the open round releases
+                    self.duplicate_count += 1
+                    self.cv.wait_for(
+                        lambda: self._round.get(key, 0) > my_round)
+                    return
+                contrib.add(worker)
+                self._applied[(key, worker)] = (seq, my_round)
             if key not in self._accum or self._count.get(key, 0) == 0:
                 self._accum[key] = np.array(value, np.float32)
                 self._count[key] = 1
@@ -319,6 +350,7 @@ class _GroupServer:
                 else:
                     self.store[key] = merged.copy()
                 self._count[key] = 0
+                self._contrib[key] = set()
                 self._round[key] = my_round + 1
                 self.cv.notify_all()
             else:
@@ -348,6 +380,8 @@ class _GroupWorkerKVStore(KVStore):
         super().__init__("dist_sync")
         self._server = server
         self._rank = rank
+        self._push_seq: dict = {}  # key -> next sequence number
+        self._retry_policy = None  # built lazily (rank-seeded jitter)
 
     @property
     def rank(self):
@@ -366,10 +400,31 @@ class _GroupWorkerKVStore(KVStore):
         self.barrier()
 
     def push(self, key, value, priority=0):
+        """Push with at-least-once delivery: every logical push carries a
+        stable (worker, seq) identity, so a resend after a chaos-injected
+        'lost request' or 'lost ack' cannot double-count at the server
+        (reference analog: ps-lite retransmission with per-message ids).
+        The retry loop only engages when a send actually fails."""
         del priority
+        from .resilience import chaos as chaos_mod
+        from .resilience.retry import RetryPolicy, retry_call
+
+        if self._retry_policy is None:
+            self._retry_policy = RetryPolicy(seed=self._rank)
         for k, vlist in self._as_pairs(key, value):
             merged = self._merge(vlist)
-            self._server.push(k, merged.asnumpy())
+            value_np = merged.asnumpy()
+            seq = self._push_seq[k] = self._push_seq.get(k, -1) + 1
+
+            def attempt(k=k, value_np=value_np, seq=seq):
+                # request lost before the server saw it
+                chaos_mod.maybe_raise("group.push.send")
+                self._server.push(k, value_np, worker=self._rank, seq=seq)
+                # ack lost after the server applied it: the retry resends
+                # the same (worker, seq) and the server deduplicates
+                chaos_mod.maybe_raise("group.push.ack")
+
+            retry_call(attempt, self._retry_policy, what=f"group.push[{k}]")
 
     def pull(self, key, out, priority=0):
         del priority

@@ -186,6 +186,12 @@ class _AsyncServer:
         # total push REQUESTS applied on arrival: one per push_many/
         # push_pull batch, one per key for the legacy single-key push op
         self.update_count = 0
+        # at-least-once delivery: mutating requests carry (rank, seq); the
+        # last applied (seq, reply) per rank lets a retry after a dead
+        # connection be answered from cache instead of re-applied (the
+        # client serializes requests per rank, so one slot suffices)
+        self._applied: dict = {}
+        self.duplicate_count = 0
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -222,6 +228,45 @@ class _AsyncServer:
         except (ConnectionError, OSError):
             return
 
+    def _replay(self, conn, ident):
+        """Dedup gate for a mutating request. Returns True when the reply
+        was (re)sent and the caller must skip the op.
+
+        The not-yet-applied decision and the claim are one atomic step:
+        the slot is marked in-progress ``(seq, None)`` under the lock
+        BEFORE the caller mutates, so a resend racing the original (e.g.
+        the client timed out while the server was still applying) waits
+        for the cached reply instead of applying the mutation twice."""
+        if ident is None:
+            return False
+        rank, seq = ident
+        with self.cv:
+            prev = self._applied.get(rank)
+            if prev is None or seq > prev[0]:
+                self._applied[rank] = (seq, None)  # claim: caller applies
+                return False
+            self.duplicate_count += 1
+            if prev[0] == seq and prev[1] is None:
+                # original still applying on another connection: wait for
+                # its reply rather than re-applying (also released if a
+                # newer seq supersedes the slot)
+                self.cv.wait_for(
+                    lambda: self._applied[rank][0] != seq or
+                    self._applied[rank][1] is not None)
+            reply = self._applied[rank][1] if self._applied[rank][0] == seq \
+                else ("err", f"request (rank {rank}, seq {seq}) superseded")
+        _send_msg(conn, reply)
+        return True
+
+    def _record(self, ident, reply):
+        """Publish the reply for a claimed (rank, seq); called for error
+        replies too, so a failed mutation never leaves waiters hung on an
+        in-progress claim."""
+        if ident is not None:
+            with self.cv:
+                self._applied[ident[0]] = (ident[1], reply)
+                self.cv.notify_all()
+
     def _handle(self, conn, msg):
         """Serve one request; True means the connection is done."""
         op = msg[0]
@@ -232,19 +277,26 @@ class _AsyncServer:
                 self.store.setdefault(key, np.array(value, np.float32))
             _send_msg(conn, ("ok",))
         elif op == "push":
-            _, key, value = msg
+            key, value = msg[1], msg[2]
+            ident = tuple(msg[3:5]) if len(msg) >= 5 else None
+            if self._replay(conn, ident):
+                return False
+            reply = ("ok",)
             with self.lock:
                 if key not in self.store:
-                    _send_msg(conn, ("err", f"key {key!r} not initialized"))
-                    return False
-                # update-on-arrival: no waiting for other workers
-                self.update_count += 1
-                if self.updater is not None:
-                    self.updater(key, np.asarray(value, np.float32),
-                                 self.store[key])
+                    reply = ("err", f"key {key!r} not initialized")
                 else:
-                    self.store[key] = np.array(value, np.float32)
-            _send_msg(conn, ("ok",))
+                    # update-on-arrival: no waiting for other workers
+                    self.update_count += 1
+                    if self.updater is not None:
+                        self.updater(key, np.asarray(value, np.float32),
+                                     self.store[key])
+                    else:
+                        self.store[key] = np.array(value, np.float32)
+            # record OUTSIDE self.lock (cv wraps the same non-reentrant
+            # lock); errors are recorded too so claim waiters never hang
+            self._record(ident, reply)
+            _send_msg(conn, reply)
         elif op == "pull":
             _, key = msg
             with self.lock:
@@ -256,29 +308,34 @@ class _AsyncServer:
             # not stall behind this connection's socket write
             _send_msg(conn, ("ok", value))
         elif op in ("push_many", "push_pull"):
-            _, kvs = msg  # dict key -> np array: ONE round trip per batch
-            reply = None
+            kvs = msg[1]  # dict key -> np array: ONE round trip per batch
+            ident = tuple(msg[2:4]) if len(msg) >= 4 else None
+            if self._replay(conn, ident):
+                return False
+            reply = ("ok",)
             with self.lock:
                 missing = [k for k in kvs if k not in self.store]
                 if missing:
-                    _send_msg(conn, ("err", f"keys not initialized: {missing}"))
-                    return False
-                self.update_count += 1
-                for k, value in kvs.items():
-                    if self.updater is not None:
-                        self.updater(k, np.asarray(value, np.float32),
-                                     self.store[k])
-                    else:
-                        self.store[k] = np.array(value, np.float32)
-                if op == "push_pull":
-                    # copy the updated weights under the lock; frame + send
-                    # the (large) reply after releasing it so each worker's
-                    # batch sync doesn't serialize the fleet on one socket
-                    reply = {k: self.store[k].copy() for k in kvs}
-            if op == "push_pull":
-                _send_msg(conn, ("ok", reply))
-            else:
-                _send_msg(conn, ("ok",))
+                    reply = ("err", f"keys not initialized: {missing}")
+                else:
+                    self.update_count += 1
+                    for k, value in kvs.items():
+                        if self.updater is not None:
+                            self.updater(k, np.asarray(value, np.float32),
+                                         self.store[k])
+                        else:
+                            self.store[k] = np.array(value, np.float32)
+                    if op == "push_pull":
+                        # copy the updated weights under the lock; frame +
+                        # send the (large) reply after releasing it so each
+                        # worker's batch sync doesn't serialize the fleet
+                        # on one socket
+                        reply = ("ok", {k: self.store[k].copy()
+                                        for k in kvs})
+            # record OUTSIDE self.lock (cv wraps the same non-reentrant
+            # lock); errors are recorded too so claim waiters never hang
+            self._record(ident, reply)
+            _send_msg(conn, reply)
         elif op == "pull_many":
             _, keys = msg
             with self.lock:
@@ -336,11 +393,16 @@ class AsyncKVStore(KVStore):
         self._rank = int(os.environ.get("MXTPU_WORKER_RANK", "0"))
         self._nproc = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
         host, port = self._server_addr()
+        self._host, self._port = host, port
         self._server = None
         if self._rank == 0:
             self._server = _AsyncServer(host, port, self._nproc)
         self._sock = self._connect(host, port)
         self._lock = threading.Lock()
+        self._next_seq = 0  # identity for at-least-once mutating requests
+        self._rpc_timeout = float(
+            os.environ.get("MXNET_TPU_RPC_TIMEOUT", "30"))
+        self._retry_policy = None  # lazy: rank-seeded jitter
 
     def _server_addr(self):
         coord = os.environ.get("MXTPU_COORDINATOR")
@@ -380,10 +442,54 @@ class AsyncKVStore(KVStore):
                         f"{host}:{port}") from None
                 time.sleep(0.2)
 
-    def _call(self, *msg):
+    def _call(self, *msg, mutating=False, retry=True, timeout="default"):
+        """One request-reply round trip with transport fault tolerance.
+
+        A dead/timed-out socket is closed and a fresh connection retries
+        the request (bounded backoff+jitter). Mutating ops carry a stable
+        (rank, seq) identity so the server answers a resend of an
+        already-applied request from its replay cache instead of applying
+        it twice. Barriers/stop are arrival-counted (not idempotent) and
+        are never retried."""
+        from .resilience import chaos as chaos_mod
+        from .resilience.retry import RetryPolicy, retry_call
+
+        if self._retry_policy is None:
+            self._retry_policy = RetryPolicy(seed=self._rank)
+        if timeout == "default":
+            timeout = self._rpc_timeout
         with self._lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
+            if mutating:
+                msg = msg + (self._rank, self._next_seq)
+                self._next_seq += 1
+
+            def attempt():
+                if self._sock is None:
+                    self._sock = self._connect(self._host, self._port)
+                if chaos_mod.fires("async.call"):
+                    # simulate the connection dying mid-request: the send
+                    # below fails and the retry path reconnects + resends
+                    self._sock.close()
+                try:
+                    self._sock.settimeout(timeout)
+                    _send_msg(self._sock, msg)
+                    reply = _recv_msg(self._sock)
+                    self._sock.settimeout(None)
+                    return reply
+                except (ConnectionError, OSError):
+                    # unknown stream state: never reuse this socket (a late
+                    # reply would desync request/response pairing)
+                    try:
+                        self._sock.close()
+                    finally:
+                        self._sock = None
+                    raise
+
+            if retry:
+                reply = retry_call(attempt, self._retry_policy,
+                                   what=f"dist_async.{msg[0]}")
+            else:
+                reply = attempt()
         if reply[0] != "ok":
             raise MXNetError(f"dist_async server: {reply[1]}")
         return reply[1] if len(reply) > 1 else None
@@ -409,7 +515,7 @@ class AsyncKVStore(KVStore):
         del priority
         for k, vlist in self._as_pairs(key, value):
             merged = self._merge(vlist)
-            self._call("push", k, merged.asnumpy())
+            self._call("push", k, merged.asnumpy(), mutating=True)
 
     def pull(self, key, out, priority=0):
         del priority
@@ -424,7 +530,8 @@ class AsyncKVStore(KVStore):
         """Push {key: numpy grad} in ONE round trip (the per-batch trainer
         path: serialized per-key round trips would dominate step time)."""
         self._call("push_many",
-                   {k: np.asarray(v, np.float32) for k, v in kvs.items()})
+                   {k: np.asarray(v, np.float32) for k, v in kvs.items()},
+                   mutating=True)
 
     def pull_many(self, keys) -> dict:
         """Pull current values for ``keys`` in one round trip."""
@@ -435,7 +542,7 @@ class AsyncKVStore(KVStore):
         the trainer's whole per-batch parameter-host sync."""
         return self._call("push_pull",
                           {k: np.asarray(v, np.float32)
-                           for k, v in kvs.items()})
+                           for k, v in kvs.items()}, mutating=True)
 
     def set_updater(self, updater):
         raise MXNetError(
@@ -448,7 +555,10 @@ class AsyncKVStore(KVStore):
                    pickle.dumps(optimizer, protocol=pickle.HIGHEST_PROTOCOL))
 
     def barrier(self):
-        self._call("barrier")
+        # arrival-counted on the server: a resend would count twice, and a
+        # legitimate barrier can outwait any timeout (stragglers) — so no
+        # retry and no deadline
+        self._call("barrier", retry=False, timeout=None)
 
     def stats(self) -> dict:
         """Server-side counters ({'update_count': N} — push requests
@@ -458,7 +568,7 @@ class AsyncKVStore(KVStore):
 
     def __del__(self):
         try:
-            self._call("stop")
+            self._call("stop", retry=False, timeout=5.0)
             self._sock.close()
         except Exception:  # interpreter teardown
             pass

@@ -40,7 +40,11 @@ class KVStoreServer:
 
     def handle_command(self, head: int, body):
         """Reference command protocol: 0 = install pickled optimizer,
-        kStopServer(-2)/kSyncMode(-3) control (kvstore_dist_server.h:22-23)."""
+        kStopServer(-2)/kSyncMode(-3) control (kvstore_dist_server.h:22-23).
+        Extension head -4: resilience stats query — returns the server's
+        per-key BSP round counters and the number of duplicate (retried)
+        pushes it deduplicated, so a chaos test can assert that resends
+        were absorbed rather than double-counted."""
         if head == 0:
             from .kvstore import wrap_np_updater
             from .optimizer import get_updater
@@ -52,6 +56,11 @@ class KVStoreServer:
             self._stopped = True
         elif head == -3:  # kSyncMode
             self.sync_mode = True
+        elif head == -4:  # resilience stats (capability extension)
+            with self.server.lock:
+                return {"rounds": dict(self.server._round),
+                        "duplicates": self.server.duplicate_count}
+        return None
 
     def run(self):
         """The reference blocks here until kStopServer; our server is

@@ -48,6 +48,9 @@ from . import ndarray as nd
 from . import optimizer as opt_mod
 from . import random as random_mod
 from . import symbol as sym_mod
+from .resilience import chaos as chaos_mod
+from .resilience import guards as guards_mod
+from .resilience import preempt as preempt_mod
 from .base import MXNetError
 from .callback import BatchEndParam
 from .context import Context, cpu, current_context
@@ -296,7 +299,9 @@ def _create_kvstore(kvstore, num_device, arg_params):
     """Reference: model.py:126-169 — resolve the kvstore strategy."""
     if kvstore is None:
         return None
-    if isinstance(kvstore, kvstore_mod.KVStore):
+    from .resilience.retry import RetryingKVStore
+
+    if isinstance(kvstore, (kvstore_mod.KVStore, RetryingKVStore)):
         return kvstore
     if isinstance(kvstore, str):
         if num_device == 1 and "dist" not in kvstore:
@@ -432,12 +437,25 @@ class FeedForward(BASE_ESTIMATOR):
         return self.symbol
 
     def _build_train_step(self, data_names, label_names, optimizer, mesh,
-                          symbol=None, metric_update=None, apply_update=True):
+                          symbol=None, metric_update=None, apply_update=True,
+                          guard_cfg=None):
+        """Compile the fused train step.
+
+        With ``guard_cfg`` (resilience.GuardConfig) the program additionally
+        threads a donated guard-state pytree and performs the non-finite
+        step guard ON DEVICE: loss is scaled by the (dynamic) loss scale,
+        one reduction pass over the gradients produces a single ``finite``
+        flag, and every state update (params, optimizer, aux, metric)
+        selects between new and old values with it — a NaN/Inf step is a
+        no-op instead of a poisoned model, with no host sync in the loop.
+        """
         graph_fn = _build_graph_fn(symbol if symbol is not None else self.symbol,
                                    is_train=True)
         compute_dtype = self.compute_dtype
 
-        def step(params, opt_state, aux, batch, rng, lr, mstate):
+        def compute(params, opt_state, aux, batch, rng, lr, mstate, gstate):
+            scale = gstate["scale"] if guard_cfg is not None else None
+
             def loss_fn(p):
                 if compute_dtype is not None:
                     p_c = {k: (v.astype(compute_dtype)
@@ -450,26 +468,67 @@ class FeedForward(BASE_ESTIMATOR):
                 outs, new_aux = graph_fn({**p_c, **b_c}, aux, rng)
                 # seed-ones cotangent: loss heads inject their own gradient
                 loss = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+                if scale is not None:
+                    loss = loss * scale
                 return loss, (outs, new_aux)
 
-            grads, (outs, new_aux) = jax.grad(loss_fn, has_aux=True)(params)
+            (loss, (outs, new_aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if scale is not None:
+                inv = 1.0 / scale
+                grads = {k: g * inv.astype(g.dtype) for k, g in grads.items()}
+            finite = None
+            if guard_cfg is not None and guard_cfg.skip_nonfinite:
+                # scaled loss + unscaled grads: overflow in either shows up
+                finite = guards_mod.finite_flag(loss, grads)
             if apply_update:
                 new_params, new_opt_state = optimizer.apply(
                     params, grads, opt_state, lr)
+                if finite is not None:
+                    new_params = guards_mod.guard_select(
+                        finite, new_params, params)
+                    new_opt_state = guards_mod.guard_select(
+                        finite, new_opt_state, opt_state)
             else:
                 # update-on-kvstore (dist_async): grads come back in the
                 # params slot; the parameter host applies the optimizer
                 new_params, new_opt_state = grads, opt_state
+            if finite is not None:
+                # aux (e.g. batchnorm moving stats) is updated by the
+                # forward pass on BOTH paths — a NaN step must not poison
+                # it even when the optimizer update happens elsewhere
+                new_aux = guards_mod.guard_select(finite, new_aux, aux)
             if metric_update is not None:
                 # fold metric accumulation into the same XLA program — no
                 # per-batch host pull (every pull is a device round-trip) —
                 # and drop the forward outputs from the program: nothing
                 # reads them, so XLA needn't materialize them every step
                 labels = [batch[n] for n in label_names]
-                mstate = metric_update(
+                new_mstate = metric_update(
                     mstate, labels, [o.astype(jnp.float32) for o in outs])
+                if finite is not None:
+                    new_mstate = guards_mod.guard_select(
+                        finite, new_mstate, mstate)
+                mstate = new_mstate
                 outs = ()
-            return new_params, new_opt_state, new_aux, outs, mstate
+            if guard_cfg is not None:
+                gstate = guards_mod.update_guard_state(
+                    guard_cfg, gstate,
+                    finite if finite is not None else jnp.bool_(True))
+            return new_params, new_opt_state, new_aux, outs, mstate, gstate
+
+        if guard_cfg is None:
+            def step(params, opt_state, aux, batch, rng, lr, mstate):
+                return compute(params, opt_state, aux, batch, rng, lr,
+                               mstate, None)[:5]
+
+            donate = (0, 1, 2, 6)
+        else:
+            def step(params, opt_state, aux, batch, rng, lr, mstate, gstate):
+                return compute(params, opt_state, aux, batch, rng, lr,
+                               mstate, gstate)
+
+            donate = (0, 1, 2, 6, 7)
 
         if mesh is None:
             # Single-device path: pin everything to the ctx device. Data
@@ -479,20 +538,21 @@ class FeedForward(BASE_ESTIMATOR):
             # (observed through the remote-TPU tunnel: 95 s/batch on the
             # 1-core host instead of 25 ms on the chip).
             dev = self.ctx[0].jax_device
-            jitted = jax.jit(step, donate_argnums=(0, 1, 2, 6))
+            jitted = jax.jit(step, donate_argnums=donate)
 
-            def run(params, opt_state, aux, batch, rng, lr, mstate):
+            def run(params, opt_state, aux, batch, rng, lr, mstate, *gstate):
                 batch = {k: _to_dev(v, dev) for k, v in batch.items()}
                 params = {k: _to_dev(v, dev) for k, v in params.items()}
                 aux = {k: _to_dev(v, dev) for k, v in aux.items()}
-                return jitted(params, opt_state, aux, batch, rng, lr, mstate)
+                return jitted(params, opt_state, aux, batch, rng, lr, mstate,
+                              *gstate)
 
             return run
         repl = NamedSharding(mesh, P())
         batch_sh = NamedSharding(mesh, P("dp"))
-        jitted = jax.jit(step, donate_argnums=(0, 1, 2, 6))
+        jitted = jax.jit(step, donate_argnums=donate)
 
-        def run(params, opt_state, aux, batch, rng, lr, mstate):
+        def run(params, opt_state, aux, batch, rng, lr, mstate, *gstate):
             batch = {k: _place(v, batch_sh) for k, v in batch.items()}
             if _needs_place(params, mesh):
                 params = jax.tree_util.tree_map(lambda v: _place(v, repl), params)
@@ -502,8 +562,11 @@ class FeedForward(BASE_ESTIMATOR):
                 aux = jax.tree_util.tree_map(lambda v: _place(v, repl), aux)
             if _needs_place(mstate, mesh):
                 mstate = jax.tree_util.tree_map(lambda v: _place(v, repl), mstate)
+            if gstate and _needs_place(gstate[0], mesh):
+                gstate = (jax.tree_util.tree_map(
+                    lambda v: _place(v, repl), gstate[0]),)
             return jitted(params, opt_state, aux, batch, rng, jnp.float32(lr),
-                          mstate)
+                          mstate, *gstate)
 
         return run
 
@@ -535,7 +598,7 @@ class FeedForward(BASE_ESTIMATOR):
     def fit(self, X, y=None, eval_data=None, eval_metric="accuracy",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, batch_size=128,
-            sharded_checkpoint_dir=None):
+            sharded_checkpoint_dir=None, guards=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -545,10 +608,22 @@ class FeedForward(BASE_ESTIMATOR):
         ``sharded_checkpoint_dir``: when set, the LIVE device state (params
         may be mesh-sharded) is checkpointed per epoch via
         utils.checkpoint.save_sharded, and training auto-resumes from the
-        newest complete step in that directory (SURVEY.md §5's TPU-native
-        checkpoint/resume: every host writes only its shards)."""
+        newest complete *valid* step in that directory (SURVEY.md §5's
+        TPU-native checkpoint/resume: every host writes only its shards;
+        torn/corrupt steps are skipped). SIGTERM mid-epoch flushes a final
+        checkpoint at the next step boundary and raises TrainingPreempted,
+        so a relaunch resumes instead of losing the epoch.
+
+        ``guards``: step-guard control — None (default; env gate
+        MXNET_TPU_GUARDS), True (default resilience.GuardConfig), or a
+        GuardConfig. With guards on, non-finite steps are skipped on
+        device (with optional dynamic loss-scale backoff), transient
+        mid-step failures are retried, and a watchdog can bound step time
+        (doc/developer-guide/resilience.md)."""
         del work_load_list
+        guard_cfg = guards_mod.GuardConfig.resolve(guards)
         resume_opt_leaves, resume_num_update = None, 0
+        resume_scale = None
         if sharded_checkpoint_dir is not None:
             from .utils import checkpoint as ckpt_mod
 
@@ -565,6 +640,7 @@ class FeedForward(BASE_ESTIMATOR):
                                    for k, v in laux.items()}
                 self.begin_epoch = int(meta.get("epoch", last))
                 resume_num_update = int(meta.get("num_update", 0))
+                resume_scale = meta.get("loss_scale")
                 (logger or logging).info(
                     "resumed sharded checkpoint step %d (epoch %d)",
                     last, self.begin_epoch)
@@ -615,13 +691,16 @@ class FeedForward(BASE_ESTIMATOR):
         self._optimizer_obj = optimizer
 
         if async_kv:
-            if sharded_checkpoint_dir is not None:
+            if sharded_checkpoint_dir is not None and num_workers > 1:
+                # single-worker dist_async (one replica, one writer) is
+                # exactly the resilience-test topology and is safe
                 raise MXNetError(
                     "sharded_checkpoint_dir is not supported with "
-                    "kvstore='dist_async': workers hold diverged replicas "
-                    "and would race on one checkpoint directory; use "
-                    "epoch_end_callback=mx.callback.do_checkpoint(prefix) "
-                    "with a per-worker prefix instead")
+                    "multi-worker kvstore='dist_async': workers hold "
+                    "diverged replicas and would race on one checkpoint "
+                    "directory; use epoch_end_callback="
+                    "mx.callback.do_checkpoint(prefix) with a per-worker "
+                    "prefix instead")
             # update_on_kvstore=True semantics: the optimizer runs on the
             # parameter host on every push (reference: pickled-optimizer
             # transport + server-side updater); rank 0's weights initialize
@@ -647,6 +726,22 @@ class FeedForward(BASE_ESTIMATOR):
         # One compiled step per bucket key (None = the single-symbol case);
         # all entries share the same live param/opt-state pytrees.
         train_steps = {}
+
+        # -- resilience wiring (all of it no-op when guards are off and no
+        # checkpoint dir is given; the unguarded hot path is unchanged) ----
+        gstate = None
+        watchdog = None
+        if guard_cfg is not None:
+            gstate = guards_mod.init_guard_state(guard_cfg, scale=resume_scale)
+            self.guard_stats = {"skipped_steps": 0, "step_retries": 0,
+                                "loss_scale": float(guard_cfg.init_scale
+                                                    if resume_scale is None
+                                                    else resume_scale)}
+            if guard_cfg.watchdog_deadline:
+                watchdog = guards_mod.StepWatchdog(guard_cfg.watchdog_deadline)
+        preempt_handler = None
+        if sharded_checkpoint_dir is not None or guard_cfg is not None:
+            preempt_handler = preempt_mod.PreemptionHandler.install()
 
         # Feed/compute overlap: batch extraction + async device transfer run
         # on a background thread (double-buffered), so an io-fed epoch costs
@@ -684,7 +779,50 @@ class FeedForward(BASE_ESTIMATOR):
                              and batch_end_callback is None)
         metric_update = eval_metric.device_update if use_device_metric else None
         num_update = resume_num_update
-        for epoch in range(self.begin_epoch, self.num_epoch or 1):
+        epoch = self.begin_epoch
+
+        def _write_back():
+            # write state back so callbacks/checkpoints see current values
+            # (device_get: sharded -> host, so predict/save work off-mesh)
+            for k in param_names:
+                self.arg_params[k] = NDArray(_host_local(params[k]))
+            for k in aux_names:
+                self.aux_params[k] = NDArray(_host_local(aux[k]))
+
+        def _guard_meta():
+            if guard_cfg is None:
+                return {}
+            return {"loss_scale": float(np.asarray(_host_local(
+                gstate["scale"])))}
+
+        def _preempt_flush():
+            """SIGTERM landed: flush the live state as checkpoint ``epoch``
+            (meta epoch = the in-progress epoch, which the relaunch redoes
+            from its start — epoch-granular resume, same as the reference's
+            per-epoch do_checkpoint) and stop via TrainingPreempted."""
+            if sharded_checkpoint_dir is not None:
+                from .utils import checkpoint as ckpt_mod
+
+                # flush points sit at step boundaries, where the params
+                # pytree always holds weights (the async path re-pulls them
+                # right after every step), so the live state is consistent
+                ckpt_mod.save_sharded(
+                    sharded_checkpoint_dir, epoch, params, aux=aux,
+                    symbol=self.symbol, opt_state=opt_state,
+                    extra_meta={"epoch": epoch, "num_update": num_update,
+                                "preempted": True, **_guard_meta()})
+                logger.info("preemption: flushed checkpoint step %d "
+                            "(epoch %d, %d updates)", epoch, epoch,
+                            num_update)
+            _write_back()
+            raise preempt_mod.TrainingPreempted(
+                f"training preempted by SIGTERM during epoch {epoch} "
+                f"(checkpoint flushed: "
+                f"{sharded_checkpoint_dir is not None})",
+                step=epoch, epoch=epoch)
+
+        try:
+          for epoch in range(self.begin_epoch, self.num_epoch or 1):
             tic = time.time()
             eval_metric.reset()
             maccum = self._DeviceMetricAccum(eval_metric)
@@ -699,6 +837,11 @@ class FeedForward(BASE_ESTIMATOR):
                         for b in train_data)
             try:
                 for batch, batch_arrays in feed:
+                    if preempt_handler is not None and \
+                            preempt_mod.preemption_requested():
+                        _preempt_flush()
+                    if watchdog is not None:
+                        watchdog.check()
                     bkey = getattr(batch, "bucket_key", None)
                     b_dnames = getattr(batch, "data_names", data_names)
                     b_lnames = getattr(batch, "label_names", label_names)
@@ -707,29 +850,68 @@ class FeedForward(BASE_ESTIMATOR):
                             b_dnames, b_lnames, optimizer, mesh,
                             symbol=self._symbol_for_bucket(bkey),
                             metric_update=metric_update,
-                            apply_update=not async_kv)
+                            apply_update=not async_kv,
+                            guard_cfg=guard_cfg)
                     train_step = train_steps[bkey]
                     rng = random_mod.next_key()
                     lr = optimizer._get_lr()
                     optimizer.num_update = num_update
-                    params, opt_state, aux, outs, maccum.state = train_step(
-                        params, opt_state, aux, batch_arrays, rng, lr,
-                        maccum.state
-                    )
+                    if guard_cfg is None:
+                        params, opt_state, aux, outs, maccum.state = \
+                            train_step(params, opt_state, aux, batch_arrays,
+                                       rng, lr, maccum.state)
+                    else:
+                        batch_arrays = self._chaos_step_sites(
+                            batch_arrays, b_dnames, watchdog)
+                        retries = guard_cfg.max_step_retries
+                        while True:
+                            try:
+                                # the injected raise fires BEFORE dispatch,
+                                # so donated buffers are still live on retry
+                                chaos_mod.maybe_raise(
+                                    "step.raise",
+                                    chaos_mod.TransientStepError)
+                                (params, opt_state, aux, outs, maccum.state,
+                                 gstate) = train_step(
+                                    params, opt_state, aux, batch_arrays,
+                                    rng, lr, maccum.state, gstate)
+                                break
+                            except chaos_mod.TransientStepError:
+                                if retries <= 0:
+                                    raise
+                                retries -= 1
+                                self.guard_stats["step_retries"] += 1
+                        if watchdog is not None:
+                            watchdog.beat()
+                    step_finite = True
+                    if guard_cfg is not None and (async_kv
+                                                  or not use_device_metric):
+                        # these paths sync to host right below anyway; the
+                        # in-jit fast path never reads this flag
+                        step_finite = bool(
+                            np.asarray(_host_local(gstate["last_finite"])))
                     if async_kv:
-                        # params slot carries grads (apply_update=False): ONE
-                        # round trip applies them on the host (updated on
-                        # arrival) and returns the fresh weights —
-                        # unbounded-staleness async, like the reference's
-                        # dist_async worker loop
-                        pulled = kv.push_pull({name: _host_local(params[name])
-                                               for name in param_names})
+                        if step_finite:
+                            # params slot carries grads (apply_update=False):
+                            # ONE round trip applies them on the host
+                            # (updated on arrival) and returns the fresh
+                            # weights — unbounded-staleness async, like the
+                            # reference's dist_async worker loop
+                            pulled = kv.push_pull(
+                                {name: _host_local(params[name])
+                                 for name in param_names})
+                        else:
+                            # guard tripped: the grads are non-finite — do
+                            # NOT poison the parameter host; re-pull the
+                            # current weights instead (the params slot holds
+                            # the bad grads and must be replaced either way)
+                            pulled = kv.pull_many(param_names)
                         params = {k: jnp.asarray(pulled[k])
                                   for k in param_names}
                     num_update += 1
                     if use_device_metric:
                         maccum.after_batch(batch.label)
-                    else:
+                    elif step_finite:
                         eval_metric.update(
                             batch.label,
                             [NDArray(_host_local(o))
@@ -748,6 +930,19 @@ class FeedForward(BASE_ESTIMATOR):
             name, value = eval_metric.get()
             logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
             logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            if guard_cfg is not None:
+                self.guard_stats["skipped_steps"] = int(np.asarray(
+                    _host_local(gstate["skipped"])))
+                self.guard_stats["loss_scale"] = float(np.asarray(
+                    _host_local(gstate["scale"])))
+                if self.guard_stats["skipped_steps"] or \
+                        self.guard_stats["step_retries"]:
+                    logger.info(
+                        "Epoch[%d] Guard: skipped_steps=%d step_retries=%d "
+                        "loss_scale=%g", epoch,
+                        self.guard_stats["skipped_steps"],
+                        self.guard_stats["step_retries"],
+                        self.guard_stats["loss_scale"])
 
             if sharded_checkpoint_dir is not None:
                 from .utils import checkpoint as ckpt_mod
@@ -756,14 +951,9 @@ class FeedForward(BASE_ESTIMATOR):
                     sharded_checkpoint_dir, epoch + 1, params, aux=aux,
                     symbol=self.symbol, opt_state=opt_state,
                     extra_meta={"epoch": epoch + 1,
-                                "num_update": num_update})
+                                "num_update": num_update, **_guard_meta()})
 
-            # write state back so callbacks/checkpoints see current values
-            # (device_get: sharded -> host, so predict/save work off-mesh)
-            for k in param_names:
-                self.arg_params[k] = NDArray(_host_local(params[k]))
-            for k in aux_names:
-                self.aux_params[k] = NDArray(_host_local(aux[k]))
+            _write_back()
 
             if eval_data is not None:
                 eval_metric.reset()
@@ -774,9 +964,43 @@ class FeedForward(BASE_ESTIMATOR):
                 logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
 
             if epoch_end_callback is not None:
+                if preempt_handler is not None and \
+                        preempt_mod.preemption_requested():
+                    _preempt_flush()  # don't start callbacks on a dead clock
                 for cb in _as_list(epoch_end_callback):
                     cb(epoch, self.symbol, self.arg_params, self.aux_params)
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+            if preempt_handler is not None:
+                preempt_mod.PreemptionHandler.uninstall()
         return self
+
+    @staticmethod
+    def _chaos_step_sites(batch_arrays, data_names, watchdog):
+        """Guarded-loop fault-injection hooks (zero work unless a chaos
+        injector is armed): ``step.nan`` poisons the batch so the step's
+        loss/grads go non-finite; ``step.hang`` simulates a wedged step by
+        stalling until the watchdog trips."""
+        cz = chaos_mod.active()
+        if cz is None:
+            return batch_arrays
+        if cz.fires("step.hang"):
+            limit = time.monotonic() + (
+                3.0 * watchdog.deadline if watchdog is not None else 1.0)
+            while time.monotonic() < limit:
+                if watchdog is not None:
+                    watchdog.check()  # raises StepTimeoutError when tripped
+                time.sleep(0.01)
+        if cz.fires("step.nan"):
+            for name in data_names:
+                v = batch_arrays.get(name)
+                if v is not None and jnp.issubdtype(
+                        jnp.asarray(v).dtype, jnp.floating):
+                    batch_arrays = dict(batch_arrays)
+                    batch_arrays[name] = jnp.asarray(v) * jnp.float32("nan")
+                    break
+        return batch_arrays
 
     def _batch_to_ctx(self, arrays):
         """Place batch arrays on the ctx device. Iterators hand over

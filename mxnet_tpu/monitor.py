@@ -18,14 +18,29 @@ import numpy as np
 from .executor import _build_graph_fn
 from .ndarray import NDArray
 
-__all__ = ["Monitor"]
+__all__ = ["Monitor", "nonfinite_count"]
+
+
+def nonfinite_count(x) -> int:
+    """Number of NaN/Inf elements in an array (0 for non-float dtypes)."""
+    x = np.asarray(x)
+    if not np.issubdtype(x.dtype, np.floating):
+        return 0
+    return int(x.size - np.isfinite(x).sum())
 
 
 class Monitor:
-    def __init__(self, interval, stat_func=None, pattern=".*"):
+    """``track_nonfinite=True`` additionally reports a ``*_nonfinite``
+    count per matched internal output and weight, so a tripped step guard
+    (resilience.GuardConfig) can be traced to the layer whose activations
+    or gradients blew up instead of being a silent skip counter."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*",
+                 track_nonfinite=False):
         self.interval = interval
         self.stat_func = stat_func or (lambda x: np.abs(x).mean())
         self.pattern = re.compile(pattern)
+        self.track_nonfinite = track_nonfinite
         self.step = 0
         self.activated = False
         self.queue = []
@@ -54,10 +69,18 @@ class Monitor:
         res = []
         for name, value in zip(internals.list_outputs(), outs):
             if self.pattern.match(name):
-                res.append((self.step, name, self.stat_func(np.asarray(value))))
+                value = np.asarray(value)
+                res.append((self.step, name, self.stat_func(value)))
+                if self.track_nonfinite:
+                    res.append((self.step, name + "_nonfinite",
+                                nonfinite_count(value)))
         for name, arr in exe.arg_dict.items():
             if self.pattern.match(name):
-                res.append((self.step, name, self.stat_func(arr.asnumpy())))
+                value = arr.asnumpy()
+                res.append((self.step, name, self.stat_func(value)))
+                if self.track_nonfinite:
+                    res.append((self.step, name + "_nonfinite",
+                                nonfinite_count(value)))
         self.queue = res
         return res
 

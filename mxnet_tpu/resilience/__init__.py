@@ -1,0 +1,33 @@
+"""Fault tolerance for the training stack (doc/developer-guide/resilience.md).
+
+Failure model (what the pieces cover):
+
+  lost / delayed kvstore messages   -> retry.RetryingKVStore (backoff +
+                                       jitter + idempotent resends)
+  a server group down               -> retry.CircuitBreaker degrading to
+                                       local aggregation
+  non-finite loss / gradients       -> guards (on-device skip + dynamic
+                                       loss-scale backoff, model.fit)
+  hung steps                        -> guards.StepWatchdog
+  preemption (SIGTERM)              -> preempt.PreemptionHandler + the
+                                       checkpoint flush in model.fit
+  torn / corrupt checkpoints        -> utils.checkpoint manifest (CRC) +
+                                       latest_step skipping invalid steps
+  proving any of it works           -> chaos (seeded fault injection,
+                                       tests only)
+"""
+
+from .chaos import (Chaos, ChaosConfig, TransientError, TransientStepError,
+                    chaos_scope)
+from . import chaos
+from .guards import GuardConfig, StepTimeoutError, StepWatchdog
+from .preempt import PreemptionHandler, TrainingPreempted
+from .retry import CircuitBreaker, CircuitOpenError, RetryingKVStore, \
+    RetryPolicy, retry_call
+
+__all__ = ["chaos", "Chaos", "ChaosConfig", "chaos_scope",
+           "TransientError", "TransientStepError",
+           "GuardConfig", "StepTimeoutError", "StepWatchdog",
+           "PreemptionHandler", "TrainingPreempted",
+           "CircuitBreaker", "CircuitOpenError", "RetryingKVStore",
+           "RetryPolicy", "retry_call"]

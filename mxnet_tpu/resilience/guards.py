@@ -1,0 +1,203 @@
+"""Step guards: keep one bad step from killing (or silently poisoning) a run.
+
+Three mechanisms, all designed to stay off the host in the hot path:
+
+  non-finite guard   The train step computes a single on-device ``finite``
+                     flag — isfinite(loss) AND isfinite(sum of per-tensor
+                     grad sums; NaN/Inf propagates through the sum, so one
+                     reduction pass covers every gradient element). The
+                     parameter/optimizer/metric updates select between new
+                     and old state with that flag, so a NaN step is a
+                     no-op instead of a poisoned model. The skip counter
+                     lives on device and is pulled once per epoch.
+
+  dynamic loss scale The guard state threads a loss scale through the
+                     jitted step: loss is scaled before grad, grads are
+                     unscaled before the update. A non-finite step backs
+                     the scale off (x ``scale_backoff``); ``growth_interval``
+                     consecutive finite steps grow it (x ``scale_growth``,
+                     capped). With ``dynamic_loss_scale=False`` the scale
+                     is pinned at ``init_scale`` (1.0 by default — pure
+                     skip-on-NaN semantics, the right default for f32).
+
+  step watchdog      A host-side deadline on step progress. The fit loop
+                     heartbeats after every completed step; if no beat
+                     lands within ``watchdog_deadline`` seconds the
+                     watchdog trips. Monitoring starts at the FIRST beat
+                     (first-step jit compile is excluded — it can
+                     legitimately take minutes). In-process a trip
+                     surfaces as
+                     ``StepTimeoutError`` at the next checkpoint (chaos
+                     hang injection polls it); for a genuinely wedged
+                     device program — which no in-process code can
+                     unblock — set MXNET_TPU_WATCHDOG_ABORT=1 and the
+                     watchdog escalates to SIGTERM, which triggers the
+                     preemption checkpoint flush, so the relaunched job
+                     resumes instead of burning its allocation hung.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+import jax.numpy as jnp
+
+from ..base import MXNetError, env_bool
+
+__all__ = ["GuardConfig", "StepTimeoutError", "StepWatchdog",
+           "init_guard_state", "finite_flag", "guard_select",
+           "update_guard_state"]
+
+
+class StepTimeoutError(MXNetError):
+    """A step exceeded the watchdog deadline."""
+
+
+class GuardConfig:
+    """Knobs for the in-step guards (see module docstring)."""
+
+    def __init__(self, skip_nonfinite=True, init_scale=1.0,
+                 dynamic_loss_scale=False, scale_backoff=0.5,
+                 scale_growth=2.0, growth_interval=200, max_scale=2.0 ** 16,
+                 min_scale=2.0 ** -14, max_step_retries=2,
+                 watchdog_deadline=None):
+        self.skip_nonfinite = skip_nonfinite
+        self.init_scale = float(init_scale)
+        self.dynamic_loss_scale = dynamic_loss_scale
+        self.scale_backoff = float(scale_backoff)
+        self.scale_growth = float(scale_growth)
+        self.growth_interval = int(growth_interval)
+        self.max_scale = float(max_scale)
+        self.min_scale = float(min_scale)
+        self.max_step_retries = int(max_step_retries)
+        self.watchdog_deadline = watchdog_deadline
+
+    @classmethod
+    def resolve(cls, guards):
+        """Normalize fit()'s ``guards`` argument: None -> env gate
+        MXNET_TPU_GUARDS, True -> defaults, GuardConfig -> itself."""
+        if guards is None:
+            return cls() if env_bool("MXNET_TPU_GUARDS", False) else None
+        if guards is True:
+            return cls()
+        if guards is False:
+            return None
+        if isinstance(guards, cls):
+            return guards
+        raise MXNetError(f"guards must be bool/None/GuardConfig, "
+                         f"got {type(guards)}")
+
+
+def init_guard_state(cfg: GuardConfig, scale=None):
+    """Device-resident guard state threaded (donated) through the step."""
+    return {
+        "scale": jnp.float32(cfg.init_scale if scale is None else scale),
+        "skipped": jnp.int32(0),
+        "streak": jnp.int32(0),
+        "last_finite": jnp.float32(1.0),
+    }
+
+
+def finite_flag(loss, grads):
+    """One scalar bool: the whole step is finite. A single reduction pass
+    over the gradients (sum per tensor, then sum of sums) — NaN and Inf
+    both propagate through addition, so no per-element isfinite tree is
+    materialized."""
+    total = loss.astype(jnp.float32)
+    for g in grads.values():
+        total = total + jnp.sum(g.astype(jnp.float32))
+    return jnp.isfinite(total)
+
+
+def guard_select(finite, new_tree, old_tree):
+    """Per-leaf select: keep the update only when the step was finite."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(finite, n, o), new_tree, old_tree)
+
+
+def update_guard_state(cfg: GuardConfig, gstate, finite):
+    """Pure update of the guard counters + loss scale (runs in-jit)."""
+    skipped = gstate["skipped"] + jnp.where(finite, 0, 1).astype(jnp.int32)
+    streak = jnp.where(finite, gstate["streak"] + 1, 0).astype(jnp.int32)
+    scale = gstate["scale"]
+    if cfg.dynamic_loss_scale:
+        grown = jnp.minimum(scale * cfg.scale_growth, cfg.max_scale)
+        backed = jnp.maximum(scale * cfg.scale_backoff, cfg.min_scale)
+        grow_now = jnp.logical_and(finite, streak >= cfg.growth_interval)
+        scale = jnp.where(finite, jnp.where(grow_now, grown, scale), backed)
+        streak = jnp.where(grow_now, 0, streak).astype(jnp.int32)
+    return {"scale": scale, "skipped": skipped, "streak": streak,
+            "last_finite": jnp.where(finite, 1.0, 0.0).astype(jnp.float32)}
+
+
+class StepWatchdog:
+    """Deadline monitor for step progress.
+
+    ``beat()`` after every completed step; ``check()`` raises
+    StepTimeoutError once the deadline has passed without a beat. A
+    background timer handles the case where the main thread never reaches
+    a check(): it logs, and with MXNET_TPU_WATCHDOG_ABORT=1 escalates to
+    SIGTERM (-> preemption flush) after one extra deadline of grace.
+    """
+
+    def __init__(self, deadline: float, abort=None):
+        self.deadline = float(deadline)
+        self.expired = False
+        self._abort = env_bool("MXNET_TPU_WATCHDOG_ABORT", False) \
+            if abort is None else abort
+        self._lock = threading.Lock()
+        self._timer = None
+        self._stopped = False
+        # NOT armed at construction: monitoring starts at the first beat()
+        # (i.e. after the first completed step), so first-step jit
+        # compilation — minutes for big programs — never counts against a
+        # per-step deadline sized for steady-state steps
+
+    def _arm(self):
+        with self._lock:
+            if self._stopped:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(self.deadline, self._trip)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _trip(self):
+        self.expired = True
+        logging.error("step watchdog: no step completed within %.1fs",
+                      self.deadline)
+        if self._abort:
+            logging.critical(
+                "step watchdog: escalating to SIGTERM (preemption flush); "
+                "hard exit in %.1fs if the flush cannot run", self.deadline)
+            os.kill(os.getpid(), signal.SIGTERM)
+            killer = threading.Timer(self.deadline,
+                                     lambda: os._exit(124))
+            killer.daemon = True
+            killer.start()
+
+    def beat(self):
+        """A step completed: clear any expiry and reset the deadline (a
+        late-but-finished step must not kill the run at the next check)."""
+        self.expired = False
+        self._arm()
+
+    def check(self):
+        """Raise if the deadline expired since the last beat."""
+        if self.expired:
+            raise StepTimeoutError(
+                f"train step exceeded watchdog deadline of "
+                f"{self.deadline:.1f}s")
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

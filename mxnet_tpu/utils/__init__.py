@@ -8,7 +8,8 @@ Replaces reference subsystems that vanish on TPU:
 from .memory import memory_stats
 from .profiler import profile_scope, start_trace, stop_trace
 from . import checkpoint
-from .checkpoint import latest_step, load_sharded, save_sharded
+from .checkpoint import latest_step, load_sharded, save_sharded, validate_step
 
 __all__ = ["memory_stats", "profile_scope", "start_trace", "stop_trace",
-           "checkpoint", "latest_step", "load_sharded", "save_sharded"]
+           "checkpoint", "latest_step", "load_sharded", "save_sharded",
+           "validate_step"]

@@ -11,21 +11,39 @@ Two tiers:
 - `save_sharded`/`load_sharded` here handle distributed state: params may
   be `jax.Array`s laid out across a mesh; restore takes an optional
   sharding pytree so resume works on a different topology.
+
+Preemption safety (ISSUE 2): a step is written into a hidden temp
+directory, a ``manifest.json`` records every file's size + CRC32, and the
+step only becomes visible through one atomic ``os.rename``. ``latest_step``
+validates candidates (manifest present, files match size and — by default —
+CRC) and skips torn or corrupt steps, so auto-resume always lands on the
+newest checkpoint that is actually loadable. A kill at ANY point therefore
+either leaves the previous steps untouched or leaves an invisible/invalid
+temp dir that the next save cleans up.
+
+Validation cost is gated by MXNET_TPU_CKPT_VERIFY: ``crc`` (default — full
+per-shard checksum on resume), ``size`` (existence + size only; for
+multi-GB checkpoints where a full read on every resume is too slow), or
+``off`` (legacy behavior: presence of state/ + metadata.json).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import zlib
 
 import jax
 import numpy as np
 
-__all__ = ["save_sharded", "load_sharded", "latest_step"]
+__all__ = ["save_sharded", "load_sharded", "latest_step", "validate_step"]
 
 _STATE_DIR = "state"
 _SYMBOL_FILE = "symbol.json"
 _META_FILE = "metadata.json"
+_MANIFEST_FILE = "manifest.json"
+_TMP_PREFIX = ".tmp."
 
 
 def _checkpointer():
@@ -34,28 +52,87 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _file_crc32(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
+
+
+def _write_manifest(step_dir, step):
+    """Record size + CRC32 of every file in the step dir (manifest and
+    metadata excluded: metadata is written after, manifest can't self-hash)."""
+    files = {}
+    for dirpath, _dirnames, filenames in os.walk(step_dir):
+        for name in sorted(filenames):
+            if name in (_MANIFEST_FILE, _META_FILE):
+                continue
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, step_dir)
+            files[rel] = {"size": os.path.getsize(full),
+                          "crc32": _file_crc32(full)}
+    manifest = {"format": 1, "step": int(step), "files": files}
+    with open(os.path.join(step_dir, _MANIFEST_FILE), "w") as f:
+        json.dump(manifest, f)
+
+
+def _chaos_corrupt(step_dir):
+    """Test hook: when the ``ckpt.corrupt`` chaos site fires, flip bytes in
+    the middle of the first (sorted) state shard — after the manifest was
+    computed, so validation must catch it."""
+    from ..resilience import chaos as chaos_mod
+
+    if not chaos_mod.fires("ckpt.corrupt"):
+        return
+    state_dir = os.path.join(step_dir, _STATE_DIR)
+    victims = []
+    for dirpath, _d, filenames in os.walk(state_dir):
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            if os.path.getsize(full) > 0:
+                victims.append(full)
+    if not victims:  # pragma: no cover - empty checkpoint
+        return
+    victim = sorted(victims)[0]
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        chunk = f.read(min(64, size - size // 2))
+        f.seek(size // 2)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    logging.warning("chaos: corrupted checkpoint shard %s", victim)
+
+
 def save_sharded(directory, step, params, aux=None, symbol=None,
                  extra_meta=None, opt_state=None):
-    """Write a sharded checkpoint for ``step`` under ``directory``.
+    """Atomically write a sharded checkpoint for ``step`` under ``directory``.
 
     params/aux may hold jax.Arrays sharded over a live mesh — each process
     persists its addressable shards (orbax/tensorstore OCDBT layout), so no
     host ever materializes the full state (the reference's rank-0
-    whole-array write cannot scale past host memory)."""
+    whole-array write cannot scale past host memory).
+
+    Write order: state + symbol + manifest + metadata all land in a hidden
+    ``.tmp.<step>`` dir; the final ``os.rename`` is the commit point. A
+    crash anywhere before it leaves earlier steps untouched.
+    """
     directory = os.path.abspath(os.fspath(directory))
-    step_dir = os.path.join(directory, str(int(step)))
-    # overwrite semantics like the reference's save_checkpoint — also clears
-    # partial state from a crash mid-save so the step can retry. The barrier
-    # runs unconditionally (not behind the exists check) so every process
-    # enters the collective regardless of what its local filesystem shows.
-    if jax.process_index() == 0 and os.path.exists(step_dir):
+    os.makedirs(directory, exist_ok=True)
+    step = int(step)
+    step_dir = os.path.join(directory, str(step))
+    tmp_dir = os.path.join(directory, f"{_TMP_PREFIX}{step}")
+    multi = jax.process_count() > 1
+    if jax.process_index() == 0 and os.path.exists(tmp_dir):
         import shutil
 
-        shutil.rmtree(step_dir)
-    if jax.process_count() > 1:
+        shutil.rmtree(tmp_dir)  # leftover from a crashed earlier attempt
+    if multi:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("mxtpu_ckpt_rm")
+        multihost_utils.sync_global_devices("mxtpu_ckpt_tmp_rm")
     state = {"params": dict(params)}
     if aux:
         state["aux"] = dict(aux)
@@ -63,30 +140,92 @@ def save_sharded(directory, step, params, aux=None, symbol=None,
         # stored as flat leaves: orbax turns tuples into lists on restore,
         # so the caller re-threads them through its own treedef
         state["opt"] = list(jax.tree_util.tree_leaves(opt_state))
-    _checkpointer().save(os.path.join(step_dir, _STATE_DIR), state)
+    _checkpointer().save(os.path.join(tmp_dir, _STATE_DIR), state)
+    if multi:
+        from jax.experimental import multihost_utils
+
+        # every process's shards must be on disk before rank 0 manifests
+        multihost_utils.sync_global_devices("mxtpu_ckpt_state_done")
     if jax.process_index() == 0:
         if symbol is not None:
-            symbol.save(os.path.join(step_dir, _SYMBOL_FILE))
-        meta = {"step": int(step)}
+            symbol.save(os.path.join(tmp_dir, _SYMBOL_FILE))
+        _write_manifest(tmp_dir, step)
+        meta = {"step": step}
         meta.update(extra_meta or {})
-        # metadata is written LAST: it is the completeness marker
-        # latest_step() keys on, so a crash mid-save never yields a
-        # "latest" checkpoint with missing symbol/meta
-        with open(os.path.join(step_dir, _META_FILE), "w") as f:
+        with open(os.path.join(tmp_dir, _META_FILE), "w") as f:
             json.dump(meta, f)
+        _chaos_corrupt(tmp_dir)
+        if os.path.exists(step_dir):
+            # overwrite semantics (reference save_checkpoint): the old step
+            # must move aside for the atomic rename; a kill inside this
+            # window loses at most THIS step — validation skips the torn
+            # leftovers and resume falls back to the previous valid step
+            import shutil
+
+            shutil.rmtree(step_dir)
+        os.rename(tmp_dir, step_dir)
+    if multi:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("mxtpu_ckpt_commit")
     return step_dir
 
 
-def latest_step(directory):
-    """Highest step with a complete state dir, or None."""
+def validate_step(directory, step, verify=None):
+    """Is checkpoint ``step`` complete and uncorrupted?
+
+    verify: 'crc' (default; full checksum), 'size', or 'off'. Steps written
+    before the manifest format existed pass when state/ + metadata.json are
+    present (the old completeness test)."""
+    verify = verify or os.environ.get("MXNET_TPU_CKPT_VERIFY", "crc")
+    step_dir = os.path.join(os.path.abspath(os.fspath(directory)),
+                            str(int(step)))
+    meta_path = os.path.join(step_dir, _META_FILE)
+    if not os.path.isdir(os.path.join(step_dir, _STATE_DIR)) or \
+            not os.path.exists(meta_path):
+        return False
+    try:
+        with open(meta_path) as f:
+            json.load(f)
+    except (OSError, ValueError):
+        return False  # torn metadata write
+    if verify == "off":
+        return True
+    manifest_path = os.path.join(step_dir, _MANIFEST_FILE)
+    if not os.path.exists(manifest_path):
+        return True  # legacy step (pre-manifest): presence is all we have
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        for rel, info in manifest["files"].items():
+            full = os.path.join(step_dir, rel)
+            if os.path.getsize(full) != info["size"]:
+                return False
+            if verify == "crc" and _file_crc32(full) != info["crc32"]:
+                return False
+    except (OSError, ValueError, KeyError):
+        return False
+    return True
+
+
+def latest_step(directory, verify=None):
+    """Highest step with a complete, valid state dir, or None.
+
+    Torn (killed mid-write) and corrupt (failing manifest CRC) steps are
+    skipped with a warning, so auto-resume lands on the newest checkpoint
+    that will actually load."""
     directory = os.fspath(directory)
     if not os.path.isdir(directory):
         return None
-    steps = [int(d) for d in os.listdir(directory)
-             if d.isdigit() and
-             os.path.isdir(os.path.join(directory, d, _STATE_DIR)) and
-             os.path.exists(os.path.join(directory, d, _META_FILE))]
-    return max(steps) if steps else None
+    steps = sorted((int(d) for d in os.listdir(directory) if d.isdigit()),
+                   reverse=True)
+    for step in steps:
+        if validate_step(directory, step, verify=verify):
+            return step
+        logging.warning(
+            "checkpoint step %d under %s is incomplete or corrupt; "
+            "skipping it for resume", step, directory)
+    return None
 
 
 def load_sharded(directory, step=None, shardings=None):

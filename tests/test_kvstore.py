@@ -166,6 +166,164 @@ def test_dist_single_process():
     kv.barrier()
 
 
+def test_group_server_duplicate_push_idempotent():
+    """Satellite (ISSUE 2): a duplicate resend of an already-applied push
+    (retry after a lost ack) must not double-count in the BSP round."""
+    n = 2
+    stores = kv_mod.create_group(n)
+    server = stores[0]._server
+    server.init(1, np.zeros(SHAPE, np.float32))
+
+    def updater(key, recv, stored):
+        stored += recv
+
+    server.updater = kv_mod.wrap_np_updater(updater)
+
+    results = {}
+
+    def worker(rank, resend):
+        # drive the server directly with explicit (worker, seq) identities
+        server.push(1, np.ones(SHAPE, np.float32) * (rank + 1),
+                    worker=rank, seq=0)
+        if resend:  # retry of the SAME logical push after a lost ack
+            server.push(1, np.ones(SHAPE, np.float32) * (rank + 1),
+                        worker=rank, seq=0)
+        results[rank] = server.pull(1)
+
+    threads = [threading.Thread(target=worker, args=(r, r == 0))
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert server.duplicate_count == 1
+    assert server._round[1] == 1  # ONE round completed, not 1.5
+    for r in range(n):
+        _same(results[r], np.ones(SHAPE) * 3)  # 1 + 2, each counted once
+
+
+def test_group_push_retries_under_chaos_lost_messages():
+    """Lost sends AND lost acks (chaos-injected) are retried by the worker
+    handle with stable (worker, seq) ids; BSP results stay exact and the
+    server reports every absorbed duplicate."""
+    from mxnet_tpu.resilience import chaos_scope
+
+    n = 3
+    stores = kv_mod.create_group(n)
+    results = {}
+    errors = []
+
+    def worker(rank):
+        try:
+            kv = stores[rank]
+            kv.init(3, mx.nd.ones(SHAPE))
+            for _ in range(3):  # 3 BSP rounds under fire
+                kv.push(3, [mx.nd.ones(SHAPE) * (rank + 1)])
+            out = mx.nd.empty(SHAPE)
+            kv.pull(3, out=out)
+            results[rank] = out.asnumpy()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    with chaos_scope(seed=11, rules={"group.push.send": 0.3,
+                                     "group.push.ack": 0.3}) as cz:
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    assert not errors, errors
+    assert cz.fired.get("group.push.ack", 0) > 0  # duplicates were produced
+    server = stores[0]._server
+    assert server.duplicate_count == cz.fired.get("group.push.ack", 0)
+    assert server._round[3] == 3  # exactly 3 rounds despite resends
+    # default (no-updater) semantics: store holds the last round's merge
+    for rank in range(n):
+        _same(results[rank], np.ones(SHAPE) * sum(r + 1 for r in range(n)))
+
+
+def test_async_server_dedups_replayed_push_pull():
+    """dist_async server: a mutating request replayed after a reconnect is
+    answered from the (rank, seq) cache, not applied twice."""
+    from mxnet_tpu.kvstore_async import AsyncKVStore
+
+    kv = AsyncKVStore()
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        opt = mx.optimizer.create("test", rescale_grad=1.0)
+        kv.set_optimizer(opt)  # w += grad
+        r1 = kv.push_pull({"w": np.ones((4,), np.float32)})
+        _same(r1["w"], 1.0)
+        # hand-replay the exact wire message (rank 0, seq 0): the server
+        # must serve the cached reply and leave the store untouched
+        from mxnet_tpu import kvstore_async as ka
+
+        with kv._lock:
+            ka._send_msg(kv._sock,
+                         ("push_pull", {"w": np.ones((4,), np.float32)},
+                          0, 0))
+            replay = ka._recv_msg(kv._sock)
+        assert replay[0] == "ok"
+        _same(replay[1]["w"], 1.0)  # the ORIGINAL reply, not 2.0
+        assert kv._server.duplicate_count == 1
+        out = kv.pull_many(["w"])
+        _same(out["w"], 1.0)  # store not double-updated
+    finally:
+        del kv
+
+
+def test_async_server_replay_racing_inflight_apply():
+    """A resend that lands while the ORIGINAL request is still applying
+    (client timed out mid-apply) must wait for the cached reply, not
+    apply the mutation twice — the in-progress claim in _replay."""
+    import socket
+    import time
+
+    from mxnet_tpu import kvstore_async as ka
+
+    kv = ka.AsyncKVStore()
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        applies = []
+
+        def slow_updater(key, recv, stored):
+            applies.append(1)
+            time.sleep(0.4)  # hold the apply so the replay races it
+            stored += recv
+
+        kv._server.updater = slow_updater
+
+        def raw_conn():
+            s = socket.create_connection((kv._host, kv._port))
+            s.sendall(ka._MAGIC)
+            assert ka._recv_exact(s, 4) == ka._MAGIC
+            return s
+
+        msg = ("push_pull", {"w": np.ones((4,), np.float32)}, 0, 0)
+        replies = {}
+
+        def send(tag, delay):
+            time.sleep(delay)
+            c = raw_conn()
+            ka._send_msg(c, msg)
+            replies[tag] = ka._recv_msg(c)
+            c.close()
+
+        threads = [threading.Thread(target=send, args=("orig", 0)),
+                   threading.Thread(target=send, args=("replay", 0.1))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(applies) == 1, "racing resend applied the mutation twice"
+        assert kv._server.duplicate_count == 1
+        _same(replies["orig"][1]["w"], 1.0)
+        _same(replies["replay"][1]["w"], 1.0)
+    finally:
+        del kv
+
+
 def test_test_optimizer_updater_semantics():
     """reference optimizer.py:162 Test: w += rescale_grad * grad; the state
     mirrors the updated weight (used by kvstore updater tests)."""

@@ -123,6 +123,64 @@ def test_pragma_suppression():
     assert _ids(lint_source(src3, "fx.py")) == ["MX101"]
 
 
+# -- MX6xx robustness fixtures (ISSUE 2 satellite) ----------------------------
+
+def test_fixture_bare_except_is_mx601():
+    src = "try:\n    risky()\nexcept:\n    pass\n"
+    findings = lint_source(src, "fx.py")
+    assert _ids(findings) == ["MX601"]
+    assert findings[0].is_error and findings[0].line == 3
+
+
+def test_fixture_unbounded_retry_loop_is_mx602():
+    src = (
+        "def send(op):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except ConnectionError:\n"
+        "            continue\n"
+    )
+    findings = lint_source(src, "fx.py")
+    assert _ids(findings) == ["MX602"]
+    assert findings[0].is_error
+
+
+def test_fixture_bounded_retry_loops_are_clean():
+    # backoff sleep bounds it
+    src = (
+        "import time\n"
+        "def send(op):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except ConnectionError:\n"
+        "            time.sleep(0.1)\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+    # a handler that escapes the loop is failure propagation, not a retry
+    src2 = (
+        "def serve(op):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            op()\n"
+        "        except OSError:\n"
+        "            return\n"
+    )
+    assert _ids(lint_source(src2, "fx.py")) == []
+    # real work in the handler (e.g. replying on a socket) is an event
+    # loop, not a blind retry
+    src3 = (
+        "def serve(conn, op):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            op()\n"
+        "        except ValueError as e:\n"
+        "            reply(conn, e)\n"
+    )
+    assert _ids(lint_source(src3, "fx.py")) == []
+
+
 # -- Pass 2: graph verifier fixtures ------------------------------------------
 
 def test_fixture_duplicate_argument():

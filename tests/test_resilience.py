@@ -1,0 +1,463 @@
+"""Resilience tier (ISSUE 2): under seeded chaos — dropped/duplicated
+kvstore messages, a corrupted checkpoint shard, injected NaN steps, SIGTERM
+mid-epoch — training completes, resumes from the last *valid* checkpoint,
+and matches the no-fault trajectory; guards cost <5% on the no-fault path.
+
+The reference framework had no story for any of this (the MXNet paper
+explicitly punts server failover to the kvstore layer); TensorFlow
+(1605.08695 §4.2) treats checkpoint-based fault tolerance as a core system
+property. This suite is the proof the rebuilt layer works.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import mlp
+from mxnet_tpu.resilience import (ChaosConfig, CircuitBreaker, GuardConfig,
+                                  RetryingKVStore, RetryPolicy,
+                                  StepTimeoutError, TrainingPreempted,
+                                  chaos_scope, retry_call)
+from mxnet_tpu.resilience.chaos import TransientError
+from mxnet_tpu.utils import latest_step, validate_step
+
+SHAPE = (4, 4)
+
+
+def _blobs(n=128):
+    rng = np.random.RandomState(0)
+    X = np.concatenate([rng.randn(n, 8) + 1.0,
+                        rng.randn(n, 8) - 1.0]).astype(np.float32)
+    y = np.concatenate([np.ones(n), np.zeros(n)]).astype(np.float32)
+    return X, y
+
+
+def _model(num_epoch=4, hidden=(16,)):
+    mx.random.seed(0)
+    return mx.FeedForward(mlp(num_classes=2, hidden=hidden),
+                          num_epoch=num_epoch, optimizer="sgd",
+                          learning_rate=0.1, initializer=mx.init.Xavier())
+
+
+# -- chaos registry -----------------------------------------------------------
+
+def test_chaos_deterministic_schedule():
+    """Same seed -> identical fire pattern; different seed -> different."""
+    def schedule(seed):
+        with chaos_scope(seed=seed, rules={"s": 0.3}) as cz:
+            return [cz.fires("s") for _ in range(50)]
+
+    a, b, c = schedule(7), schedule(7), schedule(8)
+    assert a == b
+    assert a != c
+    assert 5 < sum(a) < 25  # the probability is actually honored
+
+
+def test_chaos_occurrence_index_and_env_format():
+    with chaos_scope(seed=0, rules={"s": {2, 4}}) as cz:
+        fired = [cz.fires("s") for _ in range(6)]
+    assert fired == [False, False, True, False, True, False]
+
+    cfg = ChaosConfig.from_env("seed=9;kvstore.push=0.25;step.nan=#3")
+    assert cfg.seed == 9
+    assert cfg.rules["kvstore.push"] == 0.25
+    assert cfg.rules["step.nan"] == {3}
+
+
+def test_chaos_disarmed_is_free():
+    from mxnet_tpu.resilience import chaos as chaos_mod
+
+    assert chaos_mod.active() is None or True  # env may arm it; just probe
+    assert chaos_mod.fires("never.configured") is False
+
+
+# -- retry policy / breaker ---------------------------------------------------
+
+def test_retry_policy_bounded_backoff():
+    p = RetryPolicy(max_retries=4, base_delay=0.1, max_delay=0.5,
+                    jitter=0.0, seed=0)
+    delays = list(p.delays())
+    assert delays == [0.1, 0.2, 0.4, 0.5]  # exp growth, capped
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("drop")
+        return "ok"
+
+    slept = []
+    assert retry_call(flaky, RetryPolicy(max_retries=4, base_delay=0.01,
+                                         jitter=0.5, seed=1),
+                      sleep=slept.append) == "ok"
+    assert len(calls) == 3 and len(slept) == 2
+
+    def always_down():
+        raise ConnectionError("dead")
+
+    with pytest.raises(ConnectionError):
+        retry_call(always_down, RetryPolicy(max_retries=2, base_delay=0.001),
+                   sleep=lambda _d: None)
+
+
+def test_circuit_breaker_lifecycle():
+    now = [0.0]
+    b = CircuitBreaker(failure_threshold=2, reset_after=10.0,
+                       clock=lambda: now[0])
+    assert b.allow() and b.state == b.CLOSED
+    b.record_failure()
+    assert b.state == b.CLOSED
+    b.record_failure()
+    assert b.state == b.OPEN and not b.allow()
+    now[0] = 11.0  # reset window elapsed: one probe goes through
+    assert b.allow() and b.state == b.HALF_OPEN
+    b.record_failure()  # probe failed: straight back to open
+    assert b.state == b.OPEN
+    now[0] = 22.0
+    assert b.allow()
+    b.record_success()
+    assert b.state == b.CLOSED and b.trip_count == 2
+
+
+# -- retrying kvstore ---------------------------------------------------------
+
+class _FlakyStore(mx.kvstore.KVStore):
+    """Local store whose transport can be killed (dead=True)."""
+
+    def __init__(self):
+        super().__init__("local")
+        self.dead = False
+
+    def push(self, key, value, priority=0):
+        if self.dead:
+            raise ConnectionError("server group down")
+        super().push(key, value, priority)
+
+    def pull(self, key, out, priority=0):
+        if self.dead:
+            raise ConnectionError("server group down")
+        super().pull(key, out, priority)
+
+
+def _fast_rkv(inner, threshold=2, reset_after=0.15):
+    return RetryingKVStore(
+        inner, policy=RetryPolicy(max_retries=3, base_delay=0.001, seed=0),
+        breaker=CircuitBreaker(failure_threshold=threshold,
+                               reset_after=reset_after))
+
+
+def test_retrying_kvstore_retries_chaos_drops():
+    rkv = _fast_rkv(_FlakyStore())
+    rkv.init(3, mx.nd.ones(SHAPE))
+    with chaos_scope(seed=1, rules={"kvstore.push": 0.4}):
+        for _ in range(10):
+            rkv.push(3, [mx.nd.ones(SHAPE) * 2])
+    out = mx.nd.empty(SHAPE)
+    rkv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+    assert rkv.stats["retries"] > 0
+    assert rkv.breaker.state == "closed"  # drops retried, never tripped
+
+
+def test_retrying_kvstore_degrades_to_local_and_recovers():
+    inner = _FlakyStore()
+    rkv = _fast_rkv(inner)
+    rkv.set_updater(lambda k, recv, stored: stored.__iadd__(recv))
+    rkv.init("w", mx.nd.ones((4,)))
+
+    inner.dead = True
+    for _ in range(4):
+        rkv.push("w", [mx.nd.ones((4,))])
+    assert rkv.breaker.state == "open"
+    assert rkv.stats["degraded_ops"] >= 2
+    out = mx.nd.empty((4,))
+    rkv.pull("w", out=out)  # served from the local mirror
+    np.testing.assert_allclose(out.asnumpy(), 5.0)  # 1 + 4 degraded pushes
+
+    inner.dead = False
+    time.sleep(0.2)  # breaker reset window
+    rkv.push("w", [mx.nd.ones((4,))])  # half-open probe succeeds
+    assert rkv.breaker.state == "closed"
+    # server state wins on recovery: the pull refreshes the mirror
+    out2 = mx.nd.empty((4,))
+    rkv.pull("w", out=out2)
+    np.testing.assert_allclose(out2.asnumpy(), 2.0)  # inner saw init+1 push
+
+
+def test_async_kvstore_reconnects_through_dead_sockets():
+    from mxnet_tpu.kvstore_async import AsyncKVStore
+
+    with chaos_scope(seed=5, rules={"async.call": 0.3}) as cz:
+        kv = AsyncKVStore()
+        try:
+            kv.init("w", mx.nd.ones((8,)))
+            out = None
+            for i in range(6):
+                out = kv.push_pull({"w": np.full((8,), float(i), np.float32)})
+            np.testing.assert_allclose(out["w"], 5.0)
+            assert cz.fired.get("async.call", 0) > 0  # sockets actually died
+        finally:
+            del kv
+
+
+# -- step guards --------------------------------------------------------------
+
+def test_guard_skips_nan_step_and_matches_no_fault():
+    X, y = _blobs()
+    base = _model().fit(X, y, batch_size=32)
+    acc_base = base.score(X, y=y)
+
+    m = _model()
+    with chaos_scope(seed=3, rules={"step.nan": {5}}) as cz:
+        m.fit(X, y, batch_size=32, guards=True)
+    assert cz.fired.get("step.nan") == 1
+    assert m.guard_stats["skipped_steps"] == 1
+    acc = m.score(X, y=y)
+    assert np.isfinite(acc)
+    assert abs(acc - acc_base) <= 0.05, (acc, acc_base)
+
+    # negative control with REAL bad data (no injection hooks): one NaN
+    # sample poisons every parameter without guards, and is skipped (one
+    # step per epoch) with them
+    X_nan = X.copy()
+    X_nan[7, 3] = np.nan
+    m2 = _model(num_epoch=1)
+    m2.fit(X_nan, y, batch_size=32)
+    assert not np.isfinite(
+        next(iter(m2.arg_params.values())).asnumpy()).all()
+    m3 = _model(num_epoch=1)
+    m3.fit(X_nan, y, batch_size=32, guards=True)
+    assert m3.guard_stats["skipped_steps"] == 1
+    for v in m3.arg_params.values():
+        assert np.isfinite(v.asnumpy()).all()
+
+
+def test_guard_step_retry_on_transient_raise():
+    X, y = _blobs(64)
+    m = _model(num_epoch=2)
+    with chaos_scope(seed=0, rules={"step.raise": {3}}):
+        m.fit(X, y, batch_size=32, guards=True)
+    assert m.guard_stats["step_retries"] == 1
+    assert np.isfinite(m.score(X, y=y))
+
+
+def test_dynamic_loss_scale_backs_off_on_nan():
+    X, y = _blobs(64)
+    m = _model(num_epoch=2)
+    cfg = GuardConfig(dynamic_loss_scale=True, init_scale=8.0,
+                      scale_backoff=0.5)
+    with chaos_scope(seed=0, rules={"step.nan": {2}}):
+        m.fit(X, y, batch_size=32, guards=cfg)
+    assert m.guard_stats["skipped_steps"] == 1
+    assert m.guard_stats["loss_scale"] == pytest.approx(4.0)  # 8 * 0.5
+
+
+def test_watchdog_aborts_hung_step():
+    X, y = _blobs(64)
+    m = _model()
+    with chaos_scope(seed=0, rules={"step.hang": {1}}):
+        with pytest.raises(StepTimeoutError):
+            m.fit(X, y, batch_size=32,
+                  guards=GuardConfig(watchdog_deadline=0.4))
+
+
+def test_guard_overhead_under_5_percent():
+    """Acceptance: guards-on overhead < 5% on the no-fault path. The guard
+    is one fused reduction + selects, so the true cost is ~0; best-of-3
+    runs absorbs CI timer noise."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import metric as metric_mod
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu import random as random_mod
+    from mxnet_tpu.resilience import guards as guards_mod
+
+    def bench(guard_cfg, iters=40):
+        mx.random.seed(0)
+        m = mx.FeedForward(mlp(num_classes=10, hidden=(256, 256)),
+                           ctx=mx.cpu(), initializer=mx.init.Xavier())
+        rng = np.random.RandomState(0)
+        batch = {"data": jnp.asarray(rng.rand(256, 128).astype(np.float32)),
+                 "softmax_label": jnp.asarray(
+                     rng.randint(0, 10, 256).astype(np.float32))}
+        m._init_params({"data": (256, 128), "softmax_label": (256,)})
+        optimizer = opt_mod.create("sgd", rescale_grad=1 / 256.,
+                                   learning_rate=0.1,
+                                   arg_names=list(m.arg_params))
+        em = metric_mod.create("accuracy")
+        step = m._build_train_step(["data"], ["softmax_label"], optimizer,
+                                   None, metric_update=em.device_update,
+                                   guard_cfg=guard_cfg)
+        params = {k: jnp.asarray(v.asnumpy()) for k, v in m.arg_params.items()}
+        opt_state = optimizer.init_state_tree(params)
+        mstate = em.device_init()
+        gstate = guards_mod.init_guard_state(guard_cfg) if guard_cfg else None
+        aux = {}
+        times = []
+        for _ in range(iters):
+            key = random_mod.next_key()
+            t0 = time.perf_counter()
+            if guard_cfg is None:
+                params, opt_state, aux, _o, mstate = step(
+                    params, opt_state, aux, batch, key, 0.1, mstate)
+            else:
+                params, opt_state, aux, _o, mstate, gstate = step(
+                    params, opt_state, aux, batch, key, 0.1, mstate, gstate)
+            next(iter(params.values())).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times[5:]))
+
+    ratios = []
+    for _ in range(3):
+        base = bench(None)
+        guarded = bench(GuardConfig())
+        ratios.append(guarded / base)
+        if ratios[-1] < 1.05:
+            break
+    assert min(ratios) < 1.05, f"guard overhead ratios {ratios}"
+
+
+# -- preemption + checkpoint validity -----------------------------------------
+
+def test_sigterm_mid_epoch_flushes_and_resumes(tmp_path):
+    X, y = _blobs()
+    d = str(tmp_path / "ckpt")
+
+    def sigterm_at(param):
+        if param.epoch == 2 and param.nbatch == 3:
+            signal.raise_signal(signal.SIGTERM)
+
+    m = _model(num_epoch=4)
+    with pytest.raises(TrainingPreempted) as ei:
+        m.fit(X, y, batch_size=32, sharded_checkpoint_dir=d,
+              batch_end_callback=sigterm_at, guards=True)
+    assert ei.value.epoch == 2
+    # the flush overwrote epoch-1's step-2 checkpoint with mid-epoch-2 state
+    assert latest_step(d) == 2
+    # arg_params were written back before raising: callers can still save
+    assert np.isfinite(next(iter(m.arg_params.values())).asnumpy()).all()
+
+    m2 = _model(num_epoch=4)
+    m2.fit(X, y, batch_size=32, sharded_checkpoint_dir=d, guards=True)
+    assert m2.begin_epoch == 2  # resumed from the flushed step
+    assert latest_step(d) == 4
+    assert m2.score(X, y=y) > 0.95
+
+
+def test_corrupt_shard_resume_falls_back(tmp_path):
+    """A byte-flipped shard fails the manifest CRC; resume uses the last
+    valid step instead of crashing on a poisoned restore."""
+    X, y = _blobs(64)
+    d = str(tmp_path / "ckpt")
+    _model(num_epoch=2).fit(X, y, batch_size=32, sharded_checkpoint_dir=d)
+    assert latest_step(d) == 2
+
+    state_dir = os.path.join(d, "2", "state")
+    victims = sorted(
+        os.path.join(dp, f) for dp, _d, fs in os.walk(state_dir)
+        for f in fs if os.path.getsize(os.path.join(dp, f)) > 0)
+    with open(victims[0], "r+b") as f:
+        size = os.path.getsize(victims[0])
+        f.seek(size // 2)
+        f.write(b"\xde\xad\xbe\xef")
+    assert not validate_step(d, 2)
+    assert latest_step(d) == 1
+
+    m = _model(num_epoch=3)
+    m.fit(X, y, batch_size=32, sharded_checkpoint_dir=d)
+    assert m.begin_epoch == 1  # resumed from the last VALID step
+    assert latest_step(d) == 3
+
+
+def test_engine_wait_deadline():
+    """Satellite: host-side engine waits can be bounded (hung checkpoint
+    writes/kvstore work must surface, not wedge the loop)."""
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.engine import Engine
+
+    eng = Engine(num_workers=1)
+    eng.push(lambda: time.sleep(0.8))
+    with pytest.raises(MXNetError, match="deadline"):
+        eng.wait_for_all(timeout=0.05)
+    eng.wait_for_all()  # and without a deadline it completes fine
+
+
+def test_monitor_surfaces_nonfinite_counts():
+    """Satellite: guard trips are observable — the Monitor reports per-step
+    non-finite activation/weight counts."""
+    from mxnet_tpu.monitor import Monitor, nonfinite_count
+
+    assert nonfinite_count(np.array([1.0, np.nan, np.inf, 2.0])) == 2
+    assert nonfinite_count(np.array([1, 2, 3])) == 0
+
+    net = mx.sym.FullyConnected(data=mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    exe = net.simple_bind(mx.cpu(), data=(2, 3))
+    exe.arg_dict["data"][:] = np.array(
+        [[1.0, np.nan, 2.0], [3.0, 4.0, 5.0]], np.float32)
+    exe.arg_dict["fc_weight"][:] = np.ones((4, 3), np.float32)
+    exe.arg_dict["fc_bias"][:] = np.zeros((4,), np.float32)
+    mon = Monitor(interval=1, track_nonfinite=True)
+    mon.install(exe)
+    mon.tic()
+    stats = dict((name, val) for _s, name, val in mon.toc())
+    assert stats["data_nonfinite"] == 1
+    # NaN propagates through the matmul into half the outputs
+    assert stats["fc_output_nonfinite"] == 4
+
+
+# -- the acceptance scenario --------------------------------------------------
+
+def test_chaos_scenario_end_to_end(tmp_path):
+    """ISSUE 2 acceptance: under seeded chaos (dropped pushes through the
+    retrying dist_async transport, one corrupted checkpoint shard, an
+    injected NaN step, SIGTERM mid-epoch) an MNIST-scale FeedForward run
+    completes, resumes from the last valid checkpoint, and matches the
+    no-fault trajectory within tolerance."""
+    from mxnet_tpu.kvstore_async import AsyncKVStore
+
+    X, y = _blobs()
+    d = str(tmp_path / "ckpt")
+
+    base = _model().fit(X, y, batch_size=32)
+    acc_base = base.score(X, y=y)
+
+    def sigterm_at(param):
+        if param.epoch == 2 and param.nbatch == 4:
+            signal.raise_signal(signal.SIGTERM)
+
+    # run 1: pushes dropped at 15%, NaN injected at step 9, the SIGTERM
+    # flush checkpoint (the 3rd save) corrupted on disk
+    m = _model()
+    with chaos_scope(seed=13, rules={"kvstore.push": 0.15,
+                                     "step.nan": {9},
+                                     "ckpt.corrupt": {2}}) as cz:
+        kv = RetryingKVStore(AsyncKVStore(),
+                             policy=RetryPolicy(base_delay=0.001, seed=0))
+        with pytest.raises(TrainingPreempted):
+            m.fit(X, y, batch_size=32, kvstore=kv, sharded_checkpoint_dir=d,
+                  guards=True, batch_end_callback=sigterm_at)
+        assert cz.fired.get("kvstore.push", 0) > 0      # drops happened
+        assert kv.stats["retries"] > 0                  # and were resent
+        assert m.guard_stats["skipped_steps"] == 1      # NaN step skipped
+        del kv
+    # the corrupted flush is skipped: resume target is the epoch-1 step
+    assert latest_step(d) == 1
+
+    # run 2 (the relaunch): still dropping pushes; resumes and completes
+    m2 = _model()
+    with chaos_scope(seed=14, rules={"kvstore.push": 0.15}):
+        kv2 = RetryingKVStore(AsyncKVStore(),
+                              policy=RetryPolicy(base_delay=0.001, seed=0))
+        m2.fit(X, y, batch_size=32, kvstore=kv2, sharded_checkpoint_dir=d,
+               guards=True)
+        del kv2
+    assert m2.begin_epoch == 1
+    assert latest_step(d) == 4
+    acc = m2.score(X, y=y)
+    assert abs(acc - acc_base) <= 0.05, (acc, acc_base)

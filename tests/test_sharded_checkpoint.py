@@ -11,7 +11,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import mxnet_tpu as mx
 from mxnet_tpu.parallel import make_mesh
-from mxnet_tpu.utils import latest_step, load_sharded, save_sharded
+from mxnet_tpu.utils import (latest_step, load_sharded, save_sharded,
+                             validate_step)
 
 
 def _params(mesh):
@@ -67,6 +68,60 @@ def test_multiple_steps_and_latest(tmp_path):
     p5, _, _, _, _ = load_sharded(tmp_path, step=5)
     np.testing.assert_allclose(p5["fc1_bias"],
                                np.asarray(params["fc1_bias"]))
+
+
+def test_latest_step_skips_torn_checkpoints(tmp_path):
+    """Regression (ISSUE 2 satellite): latest_step used to return the max
+    numeric dir even when its write was torn; every torn shape must now be
+    skipped in favor of the newest VALID step."""
+    mesh = make_mesh(dp=8)
+    params = _params(mesh)
+    save_sharded(tmp_path, 1, params)
+    save_sharded(tmp_path, 2, params)
+    assert latest_step(tmp_path) == 2
+
+    # torn shape 1: a bare numeric dir (killed before any state landed)
+    os.makedirs(tmp_path / "7")
+    # torn shape 2: state dir present, metadata truncated mid-json-write
+    os.makedirs(tmp_path / "8" / "state")
+    (tmp_path / "8" / "metadata.json").write_text('{"step": ')
+    # torn shape 3: manifest lists a file whose bytes never fully landed
+    save_sharded(tmp_path, 9, params)
+    victim = None
+    for dirpath, _d, files in os.walk(tmp_path / "9" / "state"):
+        for f in sorted(files):
+            full = os.path.join(dirpath, f)
+            if os.path.getsize(full) > 0:
+                victim = full
+                break
+        if victim:
+            break
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) - 1)
+
+    assert not validate_step(tmp_path, 8)
+    assert not validate_step(tmp_path, 9)
+    assert validate_step(tmp_path, 2)
+    assert latest_step(tmp_path) == 2  # all three torn steps skipped
+    # and loading the latest actually works
+    loaded, _, _, _, _ = load_sharded(tmp_path)
+    np.testing.assert_allclose(loaded["fc1_bias"],
+                               np.asarray(params["fc1_bias"]))
+
+
+def test_save_is_atomic_no_tmp_left_behind(tmp_path):
+    """The commit point is one rename: after a successful save there is no
+    temp dir, and the manifest covers every state file with its CRC."""
+    import json
+
+    mesh = make_mesh(dp=8)
+    save_sharded(tmp_path, 4, _params(mesh))
+    assert not [d for d in os.listdir(tmp_path) if d.startswith(".tmp.")]
+    with open(tmp_path / "4" / "manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["step"] == 4 and manifest["files"]
+    for rel, info in manifest["files"].items():
+        assert os.path.getsize(tmp_path / "4" / rel) == info["size"]
 
 
 def test_crash_and_relaunch_resumes(tmp_path):
