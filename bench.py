@@ -352,6 +352,138 @@ def run_io_bench(args):
     }))
 
 
+def _compile_bench_symbol():
+    """A conv+BN net with a nontrivial XLA compile (the persistent cache's
+    win scales with compile time; a bare MLP compiles too fast to measure)."""
+    from mxnet_tpu import symbol as sym
+
+    net = sym.Variable("data")
+    for i, ch in enumerate((32, 64, 64)):
+        net = sym.Convolution(data=net, name=f"conv{i}", num_filter=ch,
+                              kernel=(3, 3), pad=(1, 1))
+        net = sym.BatchNorm(data=net, name=f"bn{i}")
+        net = sym.Activation(data=net, name=f"relu{i}", act_type="relu")
+        if i < 2:
+            net = sym.Pooling(data=net, name=f"pool{i}", kernel=(2, 2),
+                              stride=(2, 2), pool_type="max")
+    net = sym.Flatten(data=net, name="flat")
+    net = sym.FullyConnected(data=net, name="fc1", num_hidden=64)
+    net = sym.Activation(data=net, name="fcrelu", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=10)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def run_compile_bench_child(args):
+    """One measured process start: import -> build -> (optional AOT
+    precompile) -> first train step. Prints one JSON line; the parent
+    (run_compile_bench) aggregates cold/warm/AOT runs. The persistent
+    cache dir arrives via MXNET_TPU_COMPILE_CACHE (wired by the package
+    import, exactly the production path)."""
+    t0 = time.perf_counter()
+    import mxnet_tpu as mx
+    from mxnet_tpu.utils import compile as compile_mod
+
+    import_s = time.perf_counter() - t0
+    bs = args.batch_size
+    rng = np.random.RandomState(0)
+    X = rng.randn(bs, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (bs,)).astype(np.float32)
+    model = mx.FeedForward(_compile_bench_symbol(), ctx=mx.cpu()
+                           if os.environ.get("JAX_PLATFORMS", "") == "cpu"
+                           else None,
+                           num_epoch=1, learning_rate=0.1)
+    marks = []
+    first_step_cb = lambda p: marks.append(time.perf_counter())  # noqa: E731
+    precompile_s = None
+    if args.compile_bench_child == "aot":
+        t_pre = time.perf_counter()
+        # batch_end_callback must match fit()'s (it un-fuses the device
+        # metric, changing the compiled program — a mismatch orphans the
+        # whole warmup; fit warns when that happens)
+        model.precompile(
+            data_shapes={"data": (bs, 3, 32, 32)},
+            label_shapes={"softmax_label": (bs,)},
+            batch_end_callback=first_step_cb)
+        precompile_s = time.perf_counter() - t_pre
+    model.fit(X, y, batch_size=bs, batch_end_callback=first_step_cb)
+    stats = compile_mod.compile_stats()
+    print(json.dumps({
+        "import_s": round(import_s, 3),
+        "time_to_first_step_s": round(marks[0] - t0, 3),
+        "first_step_after_setup_s": round(
+            marks[0] - t0 - import_s - (precompile_s or 0.0), 3),
+        "precompile_s": (round(precompile_s, 3)
+                         if precompile_s is not None else None),
+        "compiles": stats["compiles"],
+        "compile_seconds": round(stats["compile_seconds"], 3),
+        "persistent_cache_hits": stats["persistent_cache_hits"],
+        "persistent_cache_saved_s": round(
+            stats["persistent_cache_saved_seconds"], 3),
+    }))
+
+
+def run_compile_bench(args):
+    """Cold-start vs warm-start (persistent compilation cache) time-to-
+    first-step, plus AOT-warmup wall time — each in a fresh subprocess so
+    every run pays real process start. Emits BENCH_COMPILE_r07.json."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    base = tempfile.mkdtemp(prefix="mxtpu_compile_bench_")
+
+    def child(mode, cache_dir):
+        env = {**os.environ,
+               "MXNET_TPU_COMPILE_CACHE": cache_dir,
+               "MXNET_TPU_COMPILE_CACHE_MIN_SEC": "0"}
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--compile-bench-child", mode,
+               "--batch-size", str(args.batch_size)]
+        r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                           timeout=1200)
+        if r.returncode != 0:
+            print(r.stdout + r.stderr, file=sys.stderr)
+            raise RuntimeError(f"compile-bench child ({mode}) failed")
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    cache = os.path.join(base, "cache")
+    cold = child("plain", cache)          # empty cache: full XLA compiles
+    warm = child("plain", cache)          # same cache: deserialize from disk
+    aot_cache = os.path.join(base, "aot_cache")
+    aot = child("aot", aot_cache)         # fresh cache + AOT warmup up front
+    aot_warm = child("aot", aot_cache)    # warm cache + AOT: best case
+    entries = len([f for f in os.listdir(cache) if f.endswith("-cache")]) \
+        if os.path.isdir(cache) else 0
+    result = {
+        "metric": "compile_bench_time_to_first_step_sec",
+        "unit": "seconds",
+        "batch_size": args.batch_size,
+        "cold_start_s": cold["time_to_first_step_s"],
+        "warm_start_s": warm["time_to_first_step_s"],
+        "warm_speedup": round(cold["time_to_first_step_s"]
+                              / max(warm["time_to_first_step_s"], 1e-9), 2),
+        "warm_persistent_cache_hits": warm["persistent_cache_hits"],
+        "warm_compile_saved_s": warm["persistent_cache_saved_s"],
+        "aot_precompile_s": aot["precompile_s"],
+        "aot_first_step_after_setup_s": aot["first_step_after_setup_s"],
+        "aot_warm_precompile_s": aot_warm["precompile_s"],
+        "aot_warm_first_step_after_setup_s":
+            aot_warm["first_step_after_setup_s"],
+        "cold_first_step_after_setup_s": cold["first_step_after_setup_s"],
+        "cache_entries": entries,
+        "detail": {"cold": cold, "warm": warm, "aot": aot,
+                   "aot_warm": aot_warm},
+    }
+    print(json.dumps(result))
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_COMPILE_r07.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}", file=sys.stderr)
+    shutil.rmtree(base, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=256)
@@ -371,6 +503,13 @@ def main():
                     help="resnet50: headline; inception_bn: the BASELINE "
                          "anchor architecture itself (97 img/s on GTX 980) "
                          "for a same-architecture comparison")
+    ap.add_argument("--compile-bench", action="store_true",
+                    help="cold vs warm (persistent compilation cache) "
+                         "time-to-first-step + AOT warmup wall time; "
+                         "emits BENCH_COMPILE_r07.json")
+    ap.add_argument("--compile-bench-child",
+                    choices=("plain", "aot"), default=None,
+                    help=argparse.SUPPRESS)
     ap.add_argument("--remat", nargs="?", const=r"unit\d+_out$", default="",
                     help="rematerialize activations per residual unit "
                          "(MXNET_TPU_REMAT boundary regex; bare --remat "
@@ -380,6 +519,19 @@ def main():
     args = ap.parse_args()
     if args.remat:
         os.environ["MXNET_TPU_REMAT"] = args.remat
+
+    if args.compile_bench_child:
+        # measured subprocess of --compile-bench: no watchdog/probe — the
+        # parent bounds each child's runtime
+        if args.batch_size > 64:
+            args.batch_size = 64
+        run_compile_bench_child(args)
+        return
+    if args.compile_bench:
+        if args.batch_size > 64:
+            args.batch_size = 64  # compile cost, not throughput, is measured
+        run_compile_bench(args)
+        return
 
     # Watchdog first: EVERY mode that can touch the tunnel must fail fast
     # when it wedges (see the note below) instead of eating the driver's

@@ -89,6 +89,11 @@ from . import recordio
 from . import parallel
 from . import models
 from . import utils
+
+# Persistent XLA compilation cache (doc/developer-guide/compile_cache.md):
+# opt-in via MXNET_TPU_COMPILE_CACHE so warm process starts skip XLA
+# compilation entirely — must be wired before the first compile dispatches.
+utils.compile.maybe_enable_persistent_cache_from_env()
 from . import predictor as _predictor_mod
 from .predictor import Predictor
 from . import analysis

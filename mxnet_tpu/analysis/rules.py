@@ -140,6 +140,14 @@ register_rule(
     "move logging/formatting out of the traced function (or use "
     "jax.debug.print); f-strings on tracers sync or embed shapes that "
     "force recompiles")
+register_rule(
+    "MX303", "warning",
+    "jit wrapper re-created per call / unstable static argument (the two "
+    "classic recompile bugs: every invocation traces and compiles afresh, "
+    "or the static-arg cache key changes every call)",
+    "hoist jax.jit out of the loop/call and cache the wrapper (e.g. "
+    "utils.compile.tracked_jit stored on the instance); pass static args "
+    "as stable hashable values, not freshly computed ones")
 
 # MX4xx — graph verifier (Symbol.verify)
 register_rule(

@@ -94,6 +94,22 @@ TRACING_CALLS = {
     "jax.experimental.pjit.pjit": (0,),
 }
 
+# jit-wrapper factories: creating one of these per call/iteration discards
+# the compile cache it carries — the classic recompile bug (MX303)
+_JIT_FAMILY = ("jax.jit", "jax.pmap", "jax.experimental.pjit.pjit",
+               "mxnet_tpu.utils.compile.tracked_jit", "compile.tracked_jit",
+               "compile_mod.tracked_jit")
+
+
+def _is_jit_family(path):
+    if path is None:
+        return False
+    for key in _JIT_FAMILY:
+        if path == key or path.endswith("." + key) or key.endswith("." + path):
+            return True
+    return False
+
+
 # functions passed here run on HOST even when called from traced code —
 # their bodies are exempt from the traced-code hazard rules
 CALLBACK_CALLS = {
@@ -148,6 +164,20 @@ class _ModuleScan(ast.NodeVisitor):
         self.host_names: set[str] = set()
         self.host_lambdas: set[int] = set()
         self.defs: list[ast.FunctionDef] = []
+        self._loop_depth = 0
+
+    # -- loop tracking (MX303: jit wrapper creation inside a loop) ------------
+    def visit_For(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
 
     # -- imports --------------------------------------------------------------
     def _check_import_path(self, full, node):
@@ -196,6 +226,24 @@ class _ModuleScan(ast.NodeVisitor):
 
     def visit_Call(self, node):
         dotted = _dotted(node.func, self.imports)
+        # MX303(a): `jax.jit(fn)(...)` — the wrapper (and its compile
+        # cache) dies with the expression; every call re-traces+recompiles
+        if isinstance(node.func, ast.Call):
+            inner = _dotted(node.func.func, self.imports)
+            if _is_jit_family(inner):
+                self.findings.append(Finding(
+                    get_rule("MX303"),
+                    f"`{inner}(fn)(...)` builds a fresh jit wrapper and "
+                    "discards it after one call",
+                    path=self.path, line=node.lineno, col=node.col_offset))
+        # MX303(b): a jit wrapper created inside a loop body is re-created
+        # (cache lost) on every iteration
+        if _is_jit_family(dotted) and self._loop_depth > 0:
+            self.findings.append(Finding(
+                get_rule("MX303"),
+                f"`{dotted}` called inside a loop: the wrapper's compile "
+                "cache is discarded every iteration",
+                path=self.path, line=node.lineno, col=node.col_offset))
         for key, positions in CALLBACK_CALLS.items():
             if dotted is not None and (dotted == key
                                        or key.endswith("." + dotted)
@@ -214,12 +262,27 @@ class _ModuleScan(ast.NodeVisitor):
                 if i < len(node.args):
                     self._mark_fn_operand(node.args[i])
             for kw in node.keywords:
-                if kw.arg in ("static_argnums", "static_argnames") and \
-                        isinstance(kw.value, (ast.List, ast.Set, ast.Dict)):
+                if kw.arg not in ("static_argnums", "static_argnames"):
+                    continue
+                if isinstance(kw.value, (ast.List, ast.Set, ast.Dict)):
                     self.findings.append(Finding(
                         get_rule("MX301"),
                         f"`{kw.arg}` given a "
                         f"{type(kw.value).__name__.lower()} literal",
+                        path=self.path, line=node.lineno,
+                        col=node.col_offset))
+                elif isinstance(kw.value, (ast.ListComp, ast.SetComp,
+                                           ast.DictComp, ast.GeneratorExp)) \
+                        or (isinstance(kw.value, ast.Call)
+                            and isinstance(kw.value.func, ast.Name)
+                            and kw.value.func.id in ("list", "set", "dict")):
+                    # MX303(c): unstable static arg — freshly built /
+                    # unhashable value defeats the jit cache key every call
+                    self.findings.append(Finding(
+                        get_rule("MX303"),
+                        f"`{kw.arg}` computed per call "
+                        f"({type(kw.value).__name__}): static args are "
+                        "jit-cache keys and must be stable hashables",
                         path=self.path, line=node.lineno,
                         col=node.col_offset))
         elif isinstance(node.func, ast.Attribute) and \

@@ -25,6 +25,7 @@ import numpy as np
 from .io import DataBatch, DataIter
 from .model import FeedForward
 from .ndarray import NDArray
+from .utils.compile import PadPolicy
 
 __all__ = ["BucketSentenceIter", "BucketingFeedForward"]
 
@@ -38,11 +39,30 @@ class BucketSentenceIter(DataIter):
     past the sentence end hold ``invalid_label``. Batches are yielded per
     step as ``t{i}_data`` / ``t{i}_label`` arrays of shape ``(batch,)`` so
     the same iterator drives the unrolled-symbol path.
+
+    ``pad_policy`` (utils.compile.PadPolicy, or a mode string) changes the
+    bucket assignment: ``'pow2'`` rounds each sentence length up to the
+    next power of two — with ``buckets=None`` the bucket list is derived
+    from the data, bounding the number of compiled programs at
+    log2(max_len) no matter how lengths drift between corpora.
     """
 
-    def __init__(self, sentences, buckets, batch_size, invalid_label=0,
-                 init_states=None, shuffle=True, seed=0):
+    def __init__(self, sentences, buckets=None, batch_size=32,
+                 invalid_label=0, init_states=None, shuffle=True, seed=0,
+                 pad_policy=None):
         super().__init__()
+        if isinstance(pad_policy, str):
+            pad_policy = PadPolicy(pad_policy)
+        self.pad_policy = pad_policy
+        sentences = list(sentences)
+        if buckets is None:
+            if pad_policy is None or pad_policy.mode != "pow2":
+                raise ValueError(
+                    "BucketSentenceIter: pass buckets=[...] (or "
+                    "pad_policy='pow2' to derive power-of-two buckets "
+                    "from the data)")
+            buckets = sorted({pad_policy.round_length(len(s))
+                              for s in sentences if len(s) > 0})
         self.buckets = sorted(buckets)
         self.batch_size = batch_size
         self.invalid_label = invalid_label
@@ -56,13 +76,11 @@ class BucketSentenceIter(DataIter):
         per_bucket = {b: [] for b in self.buckets}
         self.discarded = 0
         for sent in sentences:
-            n = len(sent)
-            for b in self.buckets:
-                if n <= b:
-                    per_bucket[b].append(sent)
-                    break
-            else:
+            b = self._assign_bucket(len(sent))
+            if b is None:
                 self.discarded += 1
+            else:
+                per_bucket[b].append(sent)
 
         # materialize padded (data, label) matrices per bucket
         self._data = {}
@@ -76,6 +94,34 @@ class BucketSentenceIter(DataIter):
         self.default_bucket_key = self.buckets[-1]
         self._plan = []
         self.reset()
+
+    def _assign_bucket(self, length):
+        """Bucket for one sentence length: smallest fitting bucket, or the
+        pad policy's rounding (pow2 bounds the program count)."""
+        if self.pad_policy is not None:
+            return self.pad_policy.round_length(length, self.buckets)
+        for b in self.buckets:
+            if length <= b:
+                return b
+        return None
+
+    def bucket_shapes(self):
+        """Per-bucket input shapes for AOT warmup: a list of
+        ``(bucket_key, data_shapes, label_shapes)`` for every non-empty
+        bucket — exactly the programs a ``fit`` over this iterator will
+        compile (FeedForward.precompile consumes this)."""
+        out = []
+        for b in sorted(self._data):
+            # token ids cross the wire as int32 (next() slices the int32
+            # matrix); init states ride as float32 zeros
+            data = {f"t{i}_data": ((self.batch_size,), np.int32)
+                    for i in range(b)}
+            data.update({name: tuple(shape)
+                         for name, shape in self.init_states})
+            label = {f"t{i}_label": ((self.batch_size,), np.int32)
+                     for i in range(b)}
+            out.append((b, data, label))
+        return out
 
     # iterator-level shapes describe the default (largest) bucket; parameter
     # initialization against these shapes covers every smaller bucket because

@@ -31,6 +31,7 @@ keeping the reference's memory-plan introspection story
 from __future__ import annotations
 
 import logging
+import time
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,7 @@ from . import random as _random
 from .base import MXNetError
 from .context import Context, current_context
 from .ndarray import NDArray, zeros
+from .utils import compile as compile_mod
 
 __all__ = ["Executor", "simple_bind"]
 
@@ -182,11 +184,14 @@ def _build_graph_fn(symbol, is_train: bool):
             return [(id(s), k) for s, k in bn.inputs] + [(id(z_src), z_k)]
         return [(id(s), k) for s, k in node.inputs]
 
-    def exec_node(i, node, env, aux_values, new_aux, rng):
+    def exec_node(i, node, env, aux_values, new_aux, rng, mask=None):
         """Run one compute node: reads env/aux_values, writes env/new_aux.
         Input refs always come from node_input_refs — the single
         fusion-aware source of truth the remat block resolution also uses,
-        so block externals can never disagree with what runs here."""
+        so block externals can never disagree with what runs here.
+        ``mask`` is the optional (batch,) loss validity mask (PadPolicy):
+        loss heads route through fwd_masked so padded rows inject no
+        gradient."""
         if id(node) in skip_bn:  # executes inside its fused add below
             return
         if id(node) in passthrough:  # relu folded into the producer
@@ -212,6 +217,8 @@ def _build_graph_fn(symbol, is_train: bool):
         key = jax.random.fold_in(rng, i) if node.op.need_rng else None
         if id(node) in fused_bn:
             outs, updated = node.op.fwd_fused_relu(ins, aux, is_train, key)
+        elif mask is not None and node.op.is_loss:
+            outs, updated = node.op.fwd_masked(ins, aux, is_train, key, mask)
         else:
             outs, updated = node.op.fwd(ins, aux, is_train, key)
         for k, o in enumerate(outs):
@@ -222,14 +229,14 @@ def _build_graph_fn(symbol, is_train: bool):
     segments = _remat_segments(nodes)
 
     if segments is None:
-        def fn(arg_values: dict, aux_values: dict, rng):
+        def fn(arg_values: dict, aux_values: dict, rng, mask=None):
             env = {}
             new_aux = dict(aux_values)
             for i, node in enumerate(nodes):
                 if node.is_variable:
                     env[(id(node), 0)] = arg_values[node.name]
                     continue
-                exec_node(i, node, env, aux_values, new_aux, rng)
+                exec_node(i, node, env, aux_values, new_aux, rng, mask)
             outputs = tuple(env[(id(n), i)] for n, i in symbol._heads)
             return outputs, new_aux
 
@@ -286,12 +293,12 @@ def _build_graph_fn(symbol, is_train: bool):
         note_consumption(ref, -1)
 
     def make_block_fn(members, exts, out_refs, aux_names):
-        def block_fn(ext_vals, aux_vals, rng):
+        def block_fn(ext_vals, aux_vals, rng, mask):
             env = dict(zip(exts, ext_vals))
             aux_in = dict(zip(aux_names, aux_vals))
             new_aux = {}
             for i, node in members:
-                exec_node(i, node, env, aux_in, new_aux, rng)
+                exec_node(i, node, env, aux_in, new_aux, rng, mask)
             return (tuple(env[r] for r in out_refs),
                     tuple(new_aux.get(a, aux_in[a]) for a in aux_names))
 
@@ -307,7 +314,7 @@ def _build_graph_fn(symbol, is_train: bool):
                 ("blk", make_block_fn(members, exts, out_refs, aux_names),
                  exts, out_refs, aux_names))
 
-    def fn(arg_values: dict, aux_values: dict, rng):
+    def fn(arg_values: dict, aux_values: dict, rng, mask=None):
         env = {}
         new_aux = dict(aux_values)
         for seg in compiled_blocks:
@@ -316,12 +323,12 @@ def _build_graph_fn(symbol, is_train: bool):
                 if node.is_variable:
                     env[(id(node), 0)] = arg_values[node.name]
                 else:
-                    exec_node(i, node, env, aux_values, new_aux, rng)
+                    exec_node(i, node, env, aux_values, new_aux, rng, mask)
                 continue
             _, block_fn, exts, out_refs, aux_names = seg
             outs, updated = block_fn(
                 tuple(env[r] for r in exts),
-                tuple(aux_values[a] for a in aux_names), rng)
+                tuple(aux_values[a] for a in aux_names), rng, mask)
             env.update(zip(out_refs, outs))
             new_aux.update(zip(aux_names, updated))
         outputs = tuple(env[(id(n), i)] for n, i in symbol._heads)
@@ -380,7 +387,8 @@ class Executor:
                             for n, a in self.arg_dict.items()},
                 arg_dtypes={n: a.dtype for n, a in self.arg_dict.items()})
 
-        self._fwd_fns = {}  # is_train -> jitted fn
+        self._fwd_fns = {}  # is_train -> tracked jitted fn
+        self._graph_fp = None  # lazy graph fingerprint (program labels)
         self._bwd_fn = None
         self._outputs: list[NDArray] | None = None
         self._last = None  # (arg_vals, aux_vals, rng) of last is_train fwd
@@ -394,6 +402,14 @@ class Executor:
         self._bwd_apply_fn = None
         self._res_leaves = None
         self._res_ok = True  # flips off after a failed capture attempt
+
+    def _label(self, kind: str) -> str:
+        """Program-registry label: graph fingerprint + program kind. The
+        fingerprint folds in the fusion/remat flags, so 'same symbol,
+        different rewrite config' shows up as distinct programs."""
+        if self._graph_fp is None:
+            self._graph_fp = compile_mod.graph_fingerprint(self._symbol)
+        return f"executor:{self._graph_fp}:{kind}"
 
     # -- public surface -------------------------------------------------------
     @property
@@ -437,10 +453,8 @@ class Executor:
         else:
             outs = None
         if outs is None:
-            if is_train not in self._fwd_fns:
-                fn = _build_graph_fn(self._symbol, is_train)
-                self._fwd_fns[is_train] = jax.jit(fn)
-            outs, new_aux = self._fwd_fns[is_train](arg_vals, aux_vals, rng)
+            outs, new_aux = self._get_fwd_fn(is_train)(arg_vals, aux_vals,
+                                                       rng)
 
         if is_train:
             self._last = (arg_vals, aux_vals, rng)
@@ -456,14 +470,15 @@ class Executor:
     def _diff_names(self):
         return sorted(n for n, r in self.grad_req.items() if r != "null")
 
-    def _forward_with_residuals(self, arg_vals, aux_vals, rng, diff_names):
-        """Run forward AND capture the VJP residuals in one compiled program.
+    def _get_fwd_fn(self, is_train):
+        if is_train not in self._fwd_fns:
+            fn = _build_graph_fn(self._symbol, is_train)
+            kind = "fwd_train" if is_train else "fwd_eval"
+            self._fwd_fns[is_train] = compile_mod.tracked_jit(
+                fn, label=self._label(kind))
+        return self._fwd_fns[is_train]
 
-        jax.vjp's returned closure is a registered pytree whose leaves are
-        the residual arrays, so a jitted function can emit them; the treedef
-        (recorded at trace time) reconstructs the closure inside the jitted
-        backward. This is what makes Forward/Backward each run once, like
-        the reference's split executor."""
+    def _get_fwd_res_fn(self):
         if self._fwd_res_fn is None:
             fwd = _build_graph_fn(self._symbol, True)
             cell = self._res_cell
@@ -479,7 +494,19 @@ class Executor:
                 cell["treedef"] = treedef
                 return outs, new_aux, leaves
 
-            self._fwd_res_fn = jax.jit(fwd_res)
+            self._fwd_res_fn = compile_mod.tracked_jit(
+                fwd_res, label=self._label("fwd_train_res"))
+        return self._fwd_res_fn
+
+    def _forward_with_residuals(self, arg_vals, aux_vals, rng, diff_names):
+        """Run forward AND capture the VJP residuals in one compiled program.
+
+        jax.vjp's returned closure is a registered pytree whose leaves are
+        the residual arrays, so a jitted function can emit them; the treedef
+        (recorded at trace time) reconstructs the closure inside the jitted
+        backward. This is what makes Forward/Backward each run once, like
+        the reference's split executor."""
+        self._get_fwd_res_fn()
         diff_args = {n: arg_vals[n] for n in diff_names}
         other = {n: v for n, v in arg_vals.items() if n not in diff_args}
         outs, new_aux, leaves = self._fwd_res_fn(diff_args, other, aux_vals,
@@ -514,7 +541,8 @@ class Executor:
                     (grads,) = vjp_fn(cots)
                     return grads
 
-                self._bwd_apply_fn = jax.jit(bwd_apply)
+                self._bwd_apply_fn = compile_mod.tracked_jit(
+                    bwd_apply, label=self._label("bwd_apply"))
             leaves, self._res_leaves = self._res_leaves, None
             # drop the residual references as soon as backward consumes them
             # so activation memory frees before the caller's optimizer
@@ -550,7 +578,8 @@ class Executor:
                 (grads,) = vjp_fn(cotangents)
                 return grads
 
-            self._bwd_fn = jax.jit(bwd)
+            self._bwd_fn = compile_mod.tracked_jit(
+                bwd, label=self._label("bwd_fused"))
 
         diff_args = {n: arg_vals[n] for n in diff_names}
         other = {n: v for n, v in arg_vals.items() if n not in diff_args}
@@ -568,6 +597,31 @@ class Executor:
                 holder._set_data(holder._data + g)
             else:  # write
                 holder._set_data(g)
+
+    def precompile(self, is_train=False):
+        """AOT warmup: lower + compile the forward program this executor
+        would dispatch, before the first ``forward()`` call pays the stall
+        (``.lower().compile()`` via the compile registry — see
+        doc/developer-guide/compile_cache.md). Compiles the SAME program
+        ``forward(is_train=...)`` will run: with bound gradients the
+        residual-capturing train forward, else the plain forward. Returns
+        the wall seconds spent compiling (0.0 when already warm)."""
+        arg_structs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                       for n, a in self.arg_dict.items()}
+        aux_structs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                       for n, a in self.aux_dict.items()}
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        is_train = bool(is_train)
+        diff_names = self._diff_names()
+        t0 = time.perf_counter()
+        if is_train and diff_names and self._res_ok:
+            diff = {n: arg_structs[n] for n in diff_names}
+            other = {n: v for n, v in arg_structs.items() if n not in diff}
+            self._get_fwd_res_fn().precompile(diff, other, aux_structs, rng)
+        else:
+            self._get_fwd_fn(is_train).precompile(arg_structs, aux_structs,
+                                                  rng)
+        return time.perf_counter() - t0
 
     def copy_params_from(self, arg_params, aux_params=None):
         """Copy parameter dicts into the bound arrays (reference:

@@ -47,6 +47,14 @@ class DataBatch:
         self.pad = pad
         self.index = index
 
+    @property
+    def num_valid(self):
+        """Leading rows that carry real samples (rows minus the
+        iterator-reported ``pad``) — what the trainer's PadPolicy keeps in
+        the loss/metric when it folds this batch into the compiled shape."""
+        rows = int(self.data[0].shape[0]) if self.data else 0
+        return rows - int(self.pad or 0)
+
 
 class DataIter:
     """Base iterator (reference: IIterator<DataBatch> + python DataIter)."""

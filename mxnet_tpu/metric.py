@@ -29,6 +29,10 @@ class EvalMetric:
     # sync point, SURVEY.md §3.1) — on TPU every host pull is a device
     # round-trip, so per-batch sync would serialize the step stream.
     device_supported = False
+    # metrics honoring ``device_update(..., valid=mask)`` — a (batch,) 0/1
+    # row-validity mask — set this True; the trainer's PadPolicy path needs
+    # it to keep the fused metric exact on padded tail batches
+    device_mask_supported = False
 
     def __init__(self, name):
         self.name = name
@@ -41,8 +45,10 @@ class EvalMetric:
 
         return (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
 
-    def device_update(self, state, labels, preds):
-        """Traced accumulation: returns the new (sum, count) state."""
+    def device_update(self, state, labels, preds, valid=None):
+        """Traced accumulation: returns the new (sum, count) state.
+        ``valid``, when given (device_mask_supported), is a (batch,) mask —
+        rows with 0 must contribute nothing to sum OR count."""
         raise NotImplementedError
 
     def absorb_device_state(self, state):
@@ -96,6 +102,7 @@ class Accuracy(EvalMetric):
     """Classification accuracy via row-argmax (reference: metric.py:45)."""
 
     device_supported = True
+    device_mask_supported = True
 
     def __init__(self):
         super().__init__("accuracy")
@@ -106,21 +113,34 @@ class Accuracy(EvalMetric):
         # hit counts are integral too — keep them exact past 2^24
         return (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
 
-    def device_update(self, state, labels, preds):
+    def device_update(self, state, labels, preds, valid=None):
         import jax.numpy as jnp
 
         s, n = state
         for label, pred in zip(labels, preds[: len(labels)]):
             label = label.astype(jnp.int32).ravel()
+            rows = pred.shape[0]
             if pred.ndim > 2:
                 pred3 = pred.reshape(pred.shape[0], pred.shape[1], -1)
-                s += jnp.sum(jnp.argmax(pred3, axis=1).ravel() ==
-                             label).astype(jnp.int32)
-                n += label.size
+                hit = (jnp.argmax(pred3, axis=1).ravel() == label)
+                if valid is not None:
+                    per_row = label.size // rows
+                    vmask = jnp.repeat(valid.astype(jnp.bool_), per_row)
+                    s += jnp.sum(hit & vmask).astype(jnp.int32)
+                    n += (jnp.sum(valid).astype(jnp.int32) * per_row)
+                else:
+                    s += jnp.sum(hit).astype(jnp.int32)
+                    n += label.size
             else:
-                s += jnp.sum(jnp.argmax(pred, axis=-1) ==
-                             label).astype(jnp.int32)
-                n += pred.shape[0]
+                hit = (jnp.argmax(pred, axis=-1) == label)
+                if valid is not None:
+                    vmask = _row_valid(valid, label.shape[0]).astype(
+                        jnp.bool_)
+                    s += jnp.sum(hit & vmask).astype(jnp.int32)
+                    n += jnp.sum(vmask).astype(jnp.int32)
+                else:
+                    s += jnp.sum(hit).astype(jnp.int32)
+                    n += rows
         return (s, n)
 
     def update(self, labels, preds):
@@ -141,6 +161,7 @@ class Accuracy(EvalMetric):
 @METRICS.register("top_k_accuracy")
 class TopKAccuracy(EvalMetric):
     device_supported = True
+    device_mask_supported = True
 
     def __init__(self, top_k=5):
         self.top_k = top_k
@@ -151,7 +172,7 @@ class TopKAccuracy(EvalMetric):
 
         return (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
 
-    def device_update(self, state, labels, preds):
+    def device_update(self, state, labels, preds, valid=None):
         import jax
         import jax.numpy as jnp
 
@@ -159,9 +180,14 @@ class TopKAccuracy(EvalMetric):
         for label, pred in zip(labels, preds[: len(labels)]):
             label = label.astype(jnp.int32).ravel()
             _, topk = jax.lax.top_k(pred, self.top_k)
-            s += jnp.sum(jnp.any(topk == label[:, None],
-                                 axis=1)).astype(jnp.int32)
-            n += label.shape[0]
+            hit = jnp.any(topk == label[:, None], axis=1)
+            if valid is not None:
+                vmask = _row_valid(valid, label.shape[0]).astype(jnp.bool_)
+                s += jnp.sum(hit & vmask).astype(jnp.int32)
+                n += jnp.sum(vmask).astype(jnp.int32)
+            else:
+                s += jnp.sum(hit).astype(jnp.int32)
+                n += label.shape[0]
         return (s, n)
 
     def update(self, labels, preds):
@@ -192,7 +218,9 @@ class Perplexity(EvalMetric):
             return self.name, float("nan")
         return self.name, float(np.exp(self.sum_metric / self.num_inst))
 
-    def device_update(self, state, labels, preds):
+    device_mask_supported = True
+
+    def device_update(self, state, labels, preds, valid=None):
         import jax.numpy as jnp
 
         s, n = state
@@ -200,8 +228,12 @@ class Perplexity(EvalMetric):
             lab = label.astype(jnp.int32).ravel()
             prob = pred.astype(jnp.float32)[jnp.arange(lab.shape[0]), lab]
             nll = -jnp.log(jnp.maximum(prob, self.eps))
+            keep = jnp.ones(lab.shape, jnp.bool_)
             if self.ignore_label is not None:
-                keep = (lab != self.ignore_label)
+                keep &= (lab != self.ignore_label)
+            if valid is not None:
+                keep &= _row_valid(valid, lab.shape[0]).astype(jnp.bool_)
+            if self.ignore_label is not None or valid is not None:
                 s += jnp.sum(jnp.where(keep, nll, 0.0))
                 n += jnp.sum(keep).astype(jnp.int32)
             else:
@@ -225,21 +257,50 @@ class Perplexity(EvalMetric):
                 self.num_inst += label.shape[0]
 
 
+def _row_valid(valid, n_rows):
+    """Expand a (batch,) validity mask to ``n_rows`` flattened label rows
+    (labels with T elements per batch row ravel to batch*T entries; each
+    batch row's validity covers its T positions)."""
+    import jax.numpy as jnp
+
+    if int(valid.shape[0]) == int(n_rows):
+        return valid
+    return jnp.repeat(valid, int(n_rows) // int(valid.shape[0]))
+
+
+def _masked_mean_accum(s, n, err, valid):
+    """Accumulate one batch's mean error, honoring an optional (batch,)
+    validity mask: the masked mean averages over valid elements only,
+    preserving the host path's mean-of-batch-means semantics."""
+    import jax.numpy as jnp
+
+    if valid is None:
+        return s + jnp.mean(err), n + 1
+    per_row = 1
+    for d in err.shape[1:]:
+        per_row *= int(d)
+    mask = valid.astype(jnp.float32).reshape(
+        valid.shape + (1,) * (err.ndim - 1))
+    total = jnp.maximum(jnp.sum(valid.astype(jnp.float32)) * per_row, 1.0)
+    return s + jnp.sum(err * mask) / total, n + 1
+
+
 @METRICS.register("mae")
 class MAE(EvalMetric):
     device_supported = True
+    device_mask_supported = True
 
     def __init__(self):
         super().__init__("mae")
 
-    def device_update(self, state, labels, preds):
+    def device_update(self, state, labels, preds, valid=None):
         import jax.numpy as jnp
 
         s, n = state
         for label, pred in zip(labels, preds[: len(labels)]):
-            s += jnp.mean(jnp.abs(label.reshape(pred.shape).astype(jnp.float32)
-                                  - pred.astype(jnp.float32)))
-            n += 1
+            err = jnp.abs(label.reshape(pred.shape).astype(jnp.float32)
+                          - pred.astype(jnp.float32))
+            s, n = _masked_mean_accum(s, n, err, valid)
         return (s, n)
 
     def update(self, labels, preds):
@@ -253,18 +314,19 @@ class MAE(EvalMetric):
 @METRICS.register("mse")
 class MSE(EvalMetric):
     device_supported = True
+    device_mask_supported = True
 
     def __init__(self):
         super().__init__("mse")
 
-    def device_update(self, state, labels, preds):
+    def device_update(self, state, labels, preds, valid=None):
         import jax.numpy as jnp
 
         s, n = state
         for label, pred in zip(labels, preds[: len(labels)]):
-            s += jnp.mean((label.reshape(pred.shape).astype(jnp.float32) -
-                           pred.astype(jnp.float32)) ** 2)
-            n += 1
+            err = (label.reshape(pred.shape).astype(jnp.float32) -
+                   pred.astype(jnp.float32)) ** 2
+            s, n = _masked_mean_accum(s, n, err, valid)
         return (s, n)
 
     def update(self, labels, preds):
@@ -291,20 +353,27 @@ class RMSE(EvalMetric):
 @METRICS.register("ce")
 class CrossEntropy(EvalMetric):
     device_supported = True
+    device_mask_supported = True
 
     def __init__(self, eps=1e-8):
         self.eps = eps
         super().__init__("cross-entropy")
 
-    def device_update(self, state, labels, preds):
+    def device_update(self, state, labels, preds, valid=None):
         import jax.numpy as jnp
 
         s, n = state
         for label, pred in zip(labels, preds[: len(labels)]):
             lab = label.astype(jnp.int32).ravel()
             prob = pred.astype(jnp.float32)[jnp.arange(lab.shape[0]), lab]
-            s += jnp.sum(-jnp.log(prob + self.eps))
-            n += lab.shape[0]
+            nll = -jnp.log(prob + self.eps)
+            if valid is not None:
+                vmask = _row_valid(valid, lab.shape[0]).astype(jnp.float32)
+                s += jnp.sum(nll * vmask)
+                n += jnp.sum(vmask).astype(jnp.int32)
+            else:
+                s += jnp.sum(nll)
+                n += lab.shape[0]
         return (s, n)
 
     def update(self, labels, preds):

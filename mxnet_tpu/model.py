@@ -51,6 +51,7 @@ from . import symbol as sym_mod
 from .resilience import chaos as chaos_mod
 from .resilience import guards as guards_mod
 from .resilience import preempt as preempt_mod
+from .utils import compile as compile_mod
 from .base import MXNetError
 from .callback import BatchEndParam
 from .context import Context, cpu, current_context
@@ -127,10 +128,14 @@ def _host_local(x):
 
 
 def _to_dev(x, dev):
-    """Move an array to `dev` unless it already lives there (committed
-    host arrays from data iterators must not pin jit to the cpu backend)."""
+    """Move an array to `dev` unless it already lives there COMMITTED
+    (committed host arrays from data iterators must not pin jit to the cpu
+    backend). Uncommitted arrays are committed in place even when already
+    on `dev`: the jit cache keys on placement, and a mix of committed and
+    uncommitted calls for the same shapes compiles the program twice."""
     try:
-        if isinstance(x, jax.Array) and x.devices() == {dev}:
+        if isinstance(x, jax.Array) and x.devices() == {dev} \
+                and getattr(x, "_committed", True):
             return x
     except Exception:  # pragma: no cover - non-Array leaves
         pass
@@ -334,6 +339,12 @@ class FeedForward(BASE_ESTIMATOR):
         self.kwargs = dict(kwargs)
         self._pred_fns = {}
         self._eval_fns = {}
+        # fused train programs, keyed by everything that changes the compiled
+        # step (bucket key, input names, mesh, metric, guards, pad policy,
+        # optimizer identity) — the instance-level cache lets precompile()
+        # AOT-warm the exact programs fit() will dispatch
+        self._train_fns = {}
+        self._graph_fps = {}  # bucket key -> graph fingerprint (labels)
 
     # -- pickling (reference behavior: notebooks pickle whole models) ---------
     def __getstate__(self):
@@ -341,13 +352,18 @@ class FeedForward(BASE_ESTIMATOR):
         # compiled-step caches hold jitted closures; rebuilt lazily on use
         state["_pred_fns"] = {}
         state["_eval_fns"] = {}
+        state["_train_fns"] = {}
+        state["_graph_fps"] = {}
         state.pop("_optimizer_obj", None)
+        state.pop("_opt_cache", None)
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._pred_fns = {}
         self._eval_fns = {}
+        self._train_fns = {}
+        self._graph_fps = {}
 
     # -- parameter init -------------------------------------------------------
     def _init_params(self, input_shapes, overwrite=False):
@@ -436,9 +452,69 @@ class FeedForward(BASE_ESTIMATOR):
         del bucket_key
         return self.symbol
 
+    def _fingerprint_for_bucket(self, bucket_key):
+        if bucket_key not in self._graph_fps:
+            self._graph_fps[bucket_key] = compile_mod.graph_fingerprint(
+                self._symbol_for_bucket(bucket_key))
+        return self._graph_fps[bucket_key]
+
+    def _resolve_optimizer(self, param_names, batch_size, num_workers=1):
+        """Optimizer object for this training configuration. Registry-name
+        optimizers are cached per (name, effective batch, kwargs) so
+        precompile() and a later fit() close the SAME object into their
+        train steps — the program cache key includes the optimizer identity,
+        and a fresh-but-identical object would orphan every warmed program."""
+        opt = self.optimizer
+        if not isinstance(opt, str):
+            return opt
+        sig = (opt, batch_size * num_workers,
+               repr(sorted(self.kwargs.items(), key=lambda kv: kv[0])))
+        cached = getattr(self, "_opt_cache", None)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        obj = opt_mod.create(opt, rescale_grad=1.0 / (batch_size * num_workers),
+                             arg_names=list(param_names), **self.kwargs)
+        self._opt_cache = (sig, obj)
+        return obj
+
+    def _get_train_step(self, bucket_key, data_names, label_names, optimizer,
+                        mesh, metric=None, apply_update=True, guard_cfg=None,
+                        pad_policy=None):
+        """The fused train step for one program configuration, built once
+        and cached on the instance (reference analog: GraphExecutor's
+        cached engine ops, one per shape). precompile() populates the same
+        cache, so fit()'s first batch of a warmed shape compiles nothing."""
+        key = (bucket_key, tuple(data_names), tuple(label_names),
+               id(optimizer), mesh, None if metric is None
+               else metric.device_key(), apply_update,
+               None if guard_cfg is None else repr(vars(guard_cfg)),
+               None if pad_policy is None else pad_policy.key(),
+               str(self.compute_dtype))
+        if key not in self._train_fns:
+            warmed = sum(getattr(fn, "_tracked", None) is not None
+                         and fn._tracked.aot_programs
+                         for fn in self._train_fns.values())
+            if warmed:
+                logging.warning(
+                    "building train program (bucket %r) at step time even "
+                    "though %d AOT-warmed program(s) exist — the warmup is "
+                    "orphaned by a config mismatch: precompile()'s "
+                    "eval_metric/guards/pad_policy/batch_end_callback must "
+                    "match fit()'s", bucket_key, warmed)
+            label = (f"train_step:{self._fingerprint_for_bucket(bucket_key)}"
+                     + (f":bucket={bucket_key}" if bucket_key is not None
+                        else ""))
+            self._train_fns[key] = self._build_train_step(
+                data_names, label_names, optimizer, mesh,
+                symbol=self._symbol_for_bucket(bucket_key),
+                metric_update=None if metric is None else metric.device_update,
+                apply_update=apply_update, guard_cfg=guard_cfg,
+                pad_policy=pad_policy, label=label)
+        return self._train_fns[key]
+
     def _build_train_step(self, data_names, label_names, optimizer, mesh,
                           symbol=None, metric_update=None, apply_update=True,
-                          guard_cfg=None):
+                          guard_cfg=None, pad_policy=None, label=None):
         """Compile the fused train step.
 
         With ``guard_cfg`` (resilience.GuardConfig) the program additionally
@@ -448,13 +524,26 @@ class FeedForward(BASE_ESTIMATOR):
         flag, and every state update (params, optimizer, aux, metric)
         selects between new and old values with it — a NaN/Inf step is a
         no-op instead of a poisoned model, with no host sync in the loop.
+
+        With ``pad_policy`` the program threads one extra input — the count
+        of valid leading rows — and derives a (batch,) mask from it: the
+        loss heads zero padded rows' injected gradients (ops/loss.py
+        ``fwd_masked``) and the fused metric skips them, so a tail batch
+        padded up to the training shape is metric- and loss-correct while
+        reusing the ONE compiled program (no fresh shape, no recompile).
         """
         graph_fn = _build_graph_fn(symbol if symbol is not None else self.symbol,
                                    is_train=True)
         compute_dtype = self.compute_dtype
 
-        def compute(params, opt_state, aux, batch, rng, lr, mstate, gstate):
+        def compute(params, opt_state, aux, batch, rng, lr, mstate, gstate,
+                    valid):
             scale = gstate["scale"] if guard_cfg is not None else None
+            mask = None
+            if valid is not None:
+                rows_of = label_names[0] if label_names else data_names[0]
+                n_rows = batch[rows_of].shape[0]
+                mask = (jnp.arange(n_rows) < valid).astype(jnp.float32)
 
             def loss_fn(p):
                 if compute_dtype is not None:
@@ -465,7 +554,7 @@ class FeedForward(BASE_ESTIMATOR):
                            for k, v in batch.items()}
                 else:
                     p_c, b_c = p, batch
-                outs, new_aux = graph_fn({**p_c, **b_c}, aux, rng)
+                outs, new_aux = graph_fn({**p_c, **b_c}, aux, rng, mask)
                 # seed-ones cotangent: loss heads inject their own gradient
                 loss = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
                 if scale is not None:
@@ -504,8 +593,12 @@ class FeedForward(BASE_ESTIMATOR):
                 # and drop the forward outputs from the program: nothing
                 # reads them, so XLA needn't materialize them every step
                 labels = [batch[n] for n in label_names]
-                new_mstate = metric_update(
-                    mstate, labels, [o.astype(jnp.float32) for o in outs])
+                outs_f32 = [o.astype(jnp.float32) for o in outs]
+                if mask is not None:
+                    new_mstate = metric_update(mstate, labels, outs_f32,
+                                               valid=mask)
+                else:
+                    new_mstate = metric_update(mstate, labels, outs_f32)
                 if finite is not None:
                     new_mstate = guards_mod.guard_select(
                         finite, new_mstate, mstate)
@@ -517,16 +610,32 @@ class FeedForward(BASE_ESTIMATOR):
                     finite if finite is not None else jnp.bool_(True))
             return new_params, new_opt_state, new_aux, outs, mstate, gstate
 
+        # signature tail: [gstate][valid] — donated indices stay fixed for
+        # the existing configurations; ``valid`` (a scalar) is never donated
+        padded = pad_policy is not None
         if guard_cfg is None:
-            def step(params, opt_state, aux, batch, rng, lr, mstate):
-                return compute(params, opt_state, aux, batch, rng, lr,
-                               mstate, None)[:5]
+            if padded:
+                def step(params, opt_state, aux, batch, rng, lr, mstate,
+                         valid):
+                    return compute(params, opt_state, aux, batch, rng, lr,
+                                   mstate, None, valid)[:5]
+            else:
+                def step(params, opt_state, aux, batch, rng, lr, mstate):
+                    return compute(params, opt_state, aux, batch, rng, lr,
+                                   mstate, None, None)[:5]
 
             donate = (0, 1, 2, 6)
         else:
-            def step(params, opt_state, aux, batch, rng, lr, mstate, gstate):
-                return compute(params, opt_state, aux, batch, rng, lr,
-                               mstate, gstate)
+            if padded:
+                def step(params, opt_state, aux, batch, rng, lr, mstate,
+                         gstate, valid):
+                    return compute(params, opt_state, aux, batch, rng, lr,
+                                   mstate, gstate, valid)
+            else:
+                def step(params, opt_state, aux, batch, rng, lr, mstate,
+                         gstate):
+                    return compute(params, opt_state, aux, batch, rng, lr,
+                                   mstate, gstate, None)
 
             donate = (0, 1, 2, 6, 7)
 
@@ -538,22 +647,43 @@ class FeedForward(BASE_ESTIMATOR):
             # (observed through the remote-TPU tunnel: 95 s/batch on the
             # 1-core host instead of 25 ms on the chip).
             dev = self.ctx[0].jax_device
-            jitted = jax.jit(step, donate_argnums=donate)
+            jitted = compile_mod.tracked_jit(step, label=label,
+                                             donate_argnums=donate)
 
-            def run(params, opt_state, aux, batch, rng, lr, mstate, *gstate):
+            def run(params, opt_state, aux, batch, rng, lr, mstate, *rest):
                 batch = {k: _to_dev(v, dev) for k, v in batch.items()}
                 params = {k: _to_dev(v, dev) for k, v in params.items()}
                 aux = {k: _to_dev(v, dev) for k, v in aux.items()}
-                return jitted(params, opt_state, aux, batch, rng, lr, mstate,
-                              *gstate)
+                # opt/metric/guard state must be COMMITTED to the ctx
+                # device too: the jit cache keys on arg placement, and the
+                # fresh uncommitted accumulators each epoch starts with
+                # would otherwise recompile the whole step once per epoch
+                # (found by the compile registry; see test_compile.py).
+                # Steady state (all outputs of the previous step, already
+                # committed) skips the tree walk on a first-leaf probe.
+                to_dev = lambda t: (t if not _needs_commit(t, dev)  # noqa: E731
+                                    else jax.tree_util.tree_map(
+                                        lambda v: _to_dev(v, dev), t))
+                opt_state = to_dev(opt_state)
+                mstate = to_dev(mstate)
+                rest = tuple(to_dev(r) if isinstance(r, dict)
+                             else _to_dev(jnp.asarray(r), dev) for r in rest)
+                # lr as a typed scalar: keeps the call signature identical
+                # to what precompile() lowers for, so AOT-warmed programs
+                # dispatch without consulting the jit cache at all
+                return jitted(params, opt_state, aux, batch, rng,
+                              jnp.float32(lr), mstate, *rest)
 
+            run._tracked = jitted
             return run
         repl = NamedSharding(mesh, P())
         batch_sh = NamedSharding(mesh, P("dp"))
-        jitted = jax.jit(step, donate_argnums=donate)
+        jitted = compile_mod.tracked_jit(step, label=label,
+                                         donate_argnums=donate)
 
-        def run(params, opt_state, aux, batch, rng, lr, mstate, *gstate):
-            batch = {k: _place(v, batch_sh) for k, v in batch.items()}
+        def run(params, opt_state, aux, batch, rng, lr, mstate, *rest):
+            batch = {k: _place(v, batch_sh if np.ndim(v) else repl)
+                     for k, v in batch.items()}
             if _needs_place(params, mesh):
                 params = jax.tree_util.tree_map(lambda v: _place(v, repl), params)
             if _needs_place(opt_state, mesh):
@@ -562,12 +692,14 @@ class FeedForward(BASE_ESTIMATOR):
                 aux = jax.tree_util.tree_map(lambda v: _place(v, repl), aux)
             if _needs_place(mstate, mesh):
                 mstate = jax.tree_util.tree_map(lambda v: _place(v, repl), mstate)
-            if gstate and _needs_place(gstate[0], mesh):
-                gstate = (jax.tree_util.tree_map(
-                    lambda v: _place(v, repl), gstate[0]),)
+            rest = tuple(
+                (jax.tree_util.tree_map(lambda v: _place(v, repl), r)
+                 if _needs_place(r, mesh) else r) if isinstance(r, dict)
+                else _place(jnp.asarray(r), repl) for r in rest)
             return jitted(params, opt_state, aux, batch, rng, jnp.float32(lr),
-                          mstate, *gstate)
+                          mstate, *rest)
 
+        run._tracked = jitted
         return run
 
     def _async_pull_params(self, kv, param_names):
@@ -577,7 +709,7 @@ class FeedForward(BASE_ESTIMATOR):
         for name in param_names:
             self.arg_params[name] = NDArray(pulled[name])
 
-    def _build_pred_step(self, mesh, symbol=None):
+    def _build_pred_step(self, mesh, symbol=None, label=None):
         graph_fn = _build_graph_fn(symbol if symbol is not None else self.symbol,
                                    is_train=False)
         compute_dtype = self.compute_dtype
@@ -592,13 +724,13 @@ class FeedForward(BASE_ESTIMATOR):
             outs, _ = graph_fn({**params, **batch}, aux, jnp.zeros((2,), jnp.uint32))
             return tuple(o.astype(jnp.float32) for o in outs)
 
-        return jax.jit(step)
+        return compile_mod.tracked_jit(step, label=label)
 
     # -- fit ------------------------------------------------------------------
     def fit(self, X, y=None, eval_data=None, eval_metric="accuracy",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, batch_size=128,
-            sharded_checkpoint_dir=None, guards=None):
+            sharded_checkpoint_dir=None, guards=None, pad_policy=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -619,9 +751,18 @@ class FeedForward(BASE_ESTIMATOR):
         GuardConfig. With guards on, non-finite steps are skipped on
         device (with optional dynamic loss-scale backoff), transient
         mid-step failures are retried, and a watchdog can bound step time
-        (doc/developer-guide/resilience.md)."""
+        (doc/developer-guide/resilience.md).
+
+        ``pad_policy``: tail-batch shape control — None (default; env gate
+        MXNET_TPU_PAD_POLICY), True/'bucket'/'pow2', or a
+        utils.compile.PadPolicy. With a policy, a final partial batch is
+        padded up to the training shape and masked (loss- and
+        metric-correct: padded rows inject no gradient and are excluded
+        from the metric) instead of compiling a second program for the odd
+        shape (doc/developer-guide/compile_cache.md)."""
         del work_load_list
         guard_cfg = guards_mod.GuardConfig.resolve(guards)
+        pad_policy = compile_mod.PadPolicy.resolve(pad_policy)
         resume_opt_leaves, resume_num_update = None, 0
         resume_scale = None
         if sharded_checkpoint_dir is not None:
@@ -680,14 +821,8 @@ class FeedForward(BASE_ESTIMATOR):
                 (self.arg_params if k in names else self.aux_params)[k] = \
                     NDArray(np.asarray(v))
 
-        optimizer = self.optimizer
-        if isinstance(optimizer, str):
-            optimizer = opt_mod.create(
-                optimizer,
-                rescale_grad=1.0 / (batch_size * num_workers),
-                arg_names=param_names,
-                **self.kwargs,
-            )
+        optimizer = self._resolve_optimizer(param_names, batch_size,
+                                            num_workers)
         self._optimizer_obj = optimizer
 
         if async_kv:
@@ -724,7 +859,9 @@ class FeedForward(BASE_ESTIMATOR):
                 opt_state = jax.tree_util.tree_unflatten(
                     treedef, [jnp.asarray(leaf) for leaf in resume_opt_leaves])
         # One compiled step per bucket key (None = the single-symbol case);
-        # all entries share the same live param/opt-state pytrees.
+        # all entries share the same live param/opt-state pytrees. The
+        # programs live in self._train_fns so precompile() warms the exact
+        # entries this loop dispatches; this is just the per-epoch memo.
         train_steps = {}
 
         # -- resilience wiring (all of it no-op when guards are off and no
@@ -754,6 +891,22 @@ class FeedForward(BASE_ESTIMATOR):
             for name, arr in zip(getattr(batch, "label_names", label_names),
                                  batch.label):
                 arrays[name] = arr.data
+            if pad_policy is not None:
+                # fold tail shapes back into the training shape ON THE FEED
+                # THREAD (before the async device transfer): short batches
+                # pad up by repeating the last row, iterator wrap-around
+                # rows count as padding — the step's validity mask excludes
+                # both from loss and metric
+                rows = None
+                for v in arrays.values():
+                    shape = getattr(v, "shape", None)
+                    if shape:
+                        rows = int(shape[0])
+                        break
+                target = pad_policy.round_rows(rows, batch_size)
+                arrays, num_valid = pad_policy.pad_arrays(
+                    arrays, target, pad=getattr(batch, "pad", 0) or 0)
+                arrays["__num_valid__"] = np.int32(num_valid)
             return arrays
 
         if mesh is None:
@@ -763,9 +916,13 @@ class FeedForward(BASE_ESTIMATOR):
                 return {k: _to_dev(v, _feed_dev) for k, v in arrays.items()}
         else:
             _feed_sh = NamedSharding(mesh, P("dp"))
+            _feed_repl = NamedSharding(mesh, P())
 
             def _place_batch(arrays):
-                return {k: _place(v, _feed_sh) for k, v in arrays.items()}
+                # scalars (the pad-policy valid count) replicate; real batch
+                # arrays shard on dp
+                return {k: _place(v, _feed_sh if np.ndim(v) else _feed_repl)
+                        for k, v in arrays.items()}
 
         feed_depth = int(os.environ.get("MXTPU_FEED_PREFETCH", "2"))
 
@@ -774,9 +931,14 @@ class FeedForward(BASE_ESTIMATOR):
         # and nothing needs per-batch host values: the (sum, count) scalars
         # live on device inside the train step and are pulled once per epoch.
         # With a batch_end_callback (e.g. Speedometer reading the metric) we
-        # keep the reference's per-batch host update semantics.
+        # keep the reference's per-batch host update semantics. A pad policy
+        # additionally needs the metric to honor the row-validity mask
+        # (device_mask_supported); otherwise padded batches fall back to the
+        # host metric path with the padded rows sliced off.
         use_device_metric = (eval_metric.device_supported
-                             and batch_end_callback is None)
+                             and batch_end_callback is None
+                             and (pad_policy is None
+                                  or eval_metric.device_mask_supported))
         metric_update = eval_metric.device_update if use_device_metric else None
         num_update = resume_num_update
         epoch = self.begin_epoch
@@ -824,6 +986,7 @@ class FeedForward(BASE_ESTIMATOR):
         try:
           for epoch in range(self.begin_epoch, self.num_epoch or 1):
             tic = time.time()
+            compile_snap = compile_mod.registry().snapshot()
             eval_metric.reset()
             maccum = self._DeviceMetricAccum(eval_metric)
             nbatch = 0
@@ -846,20 +1009,22 @@ class FeedForward(BASE_ESTIMATOR):
                     b_dnames = getattr(batch, "data_names", data_names)
                     b_lnames = getattr(batch, "label_names", label_names)
                     if bkey not in train_steps:
-                        train_steps[bkey] = self._build_train_step(
-                            b_dnames, b_lnames, optimizer, mesh,
-                            symbol=self._symbol_for_bucket(bkey),
-                            metric_update=metric_update,
+                        train_steps[bkey] = self._get_train_step(
+                            bkey, b_dnames, b_lnames, optimizer, mesh,
+                            metric=eval_metric if use_device_metric else None,
                             apply_update=not async_kv,
-                            guard_cfg=guard_cfg)
+                            guard_cfg=guard_cfg, pad_policy=pad_policy)
                     train_step = train_steps[bkey]
+                    pad_tail = ()
+                    if pad_policy is not None:
+                        pad_tail = (batch_arrays.pop("__num_valid__"),)
                     rng = random_mod.next_key()
                     lr = optimizer._get_lr()
                     optimizer.num_update = num_update
                     if guard_cfg is None:
                         params, opt_state, aux, outs, maccum.state = \
                             train_step(params, opt_state, aux, batch_arrays,
-                                       rng, lr, maccum.state)
+                                       rng, lr, maccum.state, *pad_tail)
                     else:
                         batch_arrays = self._chaos_step_sites(
                             batch_arrays, b_dnames, watchdog)
@@ -874,7 +1039,7 @@ class FeedForward(BASE_ESTIMATOR):
                                 (params, opt_state, aux, outs, maccum.state,
                                  gstate) = train_step(
                                     params, opt_state, aux, batch_arrays,
-                                    rng, lr, maccum.state, gstate)
+                                    rng, lr, maccum.state, gstate, *pad_tail)
                                 break
                             except chaos_mod.TransientStepError:
                                 if retries <= 0:
@@ -912,10 +1077,23 @@ class FeedForward(BASE_ESTIMATOR):
                     if use_device_metric:
                         maccum.after_batch(batch.label)
                     elif step_finite:
-                        eval_metric.update(
-                            batch.label,
-                            [NDArray(_host_local(o))
-                             for o in outs[: len(batch.label)]])
+                        outs_h = [_host_local(o)
+                                  for o in outs[: len(batch.label)]]
+                        labels_h = batch.label
+                        if pad_policy is not None:
+                            # batch.label holds the UNPADDED rows; slice the
+                            # outputs to the valid prefix (wrap-around pad
+                            # rows excluded too — that's the policy's
+                            # metric-correctness contract)
+                            nv = int(labels_h[0].shape[0]) - int(
+                                getattr(batch, "pad", 0) or 0)
+                            outs_h = [o[:nv] for o in outs_h]
+                            labels_h = [
+                                np.asarray(l.asnumpy()
+                                           if hasattr(l, "asnumpy") else l)[:nv]
+                                for l in labels_h]
+                        eval_metric.update(labels_h,
+                                           [NDArray(o) for o in outs_h])
                     nbatch += 1
                     if batch_end_callback is not None:
                         p = BatchEndParam(epoch=epoch, nbatch=nbatch,
@@ -930,6 +1108,23 @@ class FeedForward(BASE_ESTIMATOR):
             name, value = eval_metric.get()
             logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
             logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            cdiff = compile_mod.registry().snapshot()
+            if cdiff["compiles"] > compile_snap["compiles"]:
+                # compile activity this epoch (expected in epoch 1 / on a
+                # new bucket; anything later is shape drift — see
+                # RecompileTracker): programs, seconds, cache traffic
+                logger.info(
+                    "Epoch[%d] Compile: %d XLA compile(s), %.2fs "
+                    "(jit hits=%d misses=%d, persistent-cache hits=%d, "
+                    "saved=%.2fs)", epoch,
+                    cdiff["compiles"] - compile_snap["compiles"],
+                    cdiff["compile_seconds"] - compile_snap["compile_seconds"],
+                    cdiff["hits"] - compile_snap["hits"],
+                    cdiff["misses"] - compile_snap["misses"],
+                    cdiff["persistent_cache_hits"]
+                    - compile_snap["persistent_cache_hits"],
+                    cdiff["persistent_cache_saved_seconds"]
+                    - compile_snap["persistent_cache_saved_seconds"])
             if guard_cfg is not None:
                 self.guard_stats["skipped_steps"] = int(np.asarray(
                     _host_local(gstate["skipped"])))
@@ -975,6 +1170,142 @@ class FeedForward(BASE_ESTIMATOR):
             if preempt_handler is not None:
                 preempt_mod.PreemptionHandler.uninstall()
         return self
+
+    # -- AOT warmup -----------------------------------------------------------
+    def precompile(self, data_shapes=None, label_shapes=None, *, data=None,
+                   eval_metric="accuracy", kvstore="local", guards=None,
+                   pad_policy=None, batch_end_callback=None, parallel=True):
+        """AOT warmup: compile every fused train program ``fit`` would need
+        BEFORE training, via ``.lower().compile()`` — so step 1 of each
+        shape dispatches a ready executable instead of stalling on XLA
+        (minutes per program on a real pod). Programs compile in parallel
+        threads (XLA releases the GIL), and land in the same instance cache
+        ``fit`` consults, keyed by the exact program configuration.
+
+        Shapes: pass ``data_shapes``/``label_shapes`` dicts (input name ->
+        full batch shape, optionally ``(shape, dtype)``), or ``data=`` a
+        DataIter to read them off ``provide_data``/``provide_label`` — a
+        ``BucketSentenceIter`` warms one program per non-empty bucket.
+        ``eval_metric``/``guards``/``pad_policy``/``batch_end_callback``
+        must match the eventual ``fit`` call — each changes the compiled
+        program (a batch callback forces the per-batch host metric path,
+        un-fusing the device metric). ``fit`` warns if a mismatch orphans
+        the warmed programs.
+
+        Returns ``{"programs", "wall_seconds", "labels"}``. Combine with
+        ``MXNET_TPU_COMPILE_CACHE`` for warm restarts: the first process
+        pays XLA once, every later precompile deserializes from disk.
+        """
+        if isinstance(kvstore, str) and "dist" in kvstore:
+            raise MXNetError(
+                "precompile: multi-process kvstore strategies must warm up "
+                "inside the launched job (the mesh spans processes); call "
+                "precompile there, or rely on the persistent cache")
+        programs = []
+        if data is not None:
+            if hasattr(data, "bucket_shapes"):
+                programs = [(bk, dict(d), dict(l))
+                            for bk, d, l in data.bucket_shapes()]
+            else:
+                programs = [(None, dict(data.provide_data),
+                             dict(data.provide_label))]
+        elif data_shapes:
+            programs = [(None, dict(data_shapes), dict(label_shapes or {}))]
+        if not programs:
+            raise MXNetError(
+                "precompile: pass data_shapes (+label_shapes) or "
+                "data=<DataIter>")
+
+        def _split(spec):
+            # shape, or (shape, dtype)
+            if (isinstance(spec, tuple) and len(spec) == 2
+                    and isinstance(spec[0], (tuple, list))):
+                return tuple(spec[0]), np.dtype(spec[1])
+            return tuple(spec), np.dtype(np.float32)
+
+        guard_cfg = guards_mod.GuardConfig.resolve(guards)
+        pad_policy = compile_mod.PadPolicy.resolve(pad_policy)
+        metric = metric_mod.create(eval_metric)
+        # same fusion decision as fit(): a batch callback needs per-batch
+        # host metric values, so the metric stays out of the step program
+        use_device_metric = (metric.device_supported
+                             and batch_end_callback is None
+                             and (pad_policy is None
+                                  or metric.device_mask_supported))
+
+        if data is not None:
+            init_shapes = {**dict(data.provide_data),
+                           **dict(data.provide_label)}
+        else:
+            init_shapes = {k: _split(v)[0]
+                           for k, v in {**programs[0][1],
+                                        **programs[0][2]}.items()}
+        param_names, aux_names = self._init_params(init_shapes)
+        first_shape = _split(next(iter(programs[0][1].values())))[0]
+        batch_size = int(first_shape[0])
+        mesh = self._make_mesh(dist=False)
+        optimizer = self._resolve_optimizer(param_names, batch_size)
+
+        def _sds(shape, dtype, sharded=False):
+            if mesh is None:
+                return jax.ShapeDtypeStruct(shape, dtype)
+            sh = NamedSharding(mesh, P("dp") if sharded else P())
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+
+        params_s = {k: _sds(tuple(self.arg_params[k].shape),
+                            self.arg_params[k].dtype) for k in param_names}
+        aux_s = {k: _sds(tuple(self.aux_params[k].shape),
+                         self.aux_params[k].dtype) for k in aux_names}
+        opt_state_s = jax.eval_shape(optimizer.init_state_tree, params_s)
+        if mesh is not None:
+            opt_state_s = jax.tree_util.tree_map(
+                lambda s: _sds(tuple(s.shape), s.dtype), opt_state_s)
+        rng_s = _sds((2,), np.dtype(np.uint32))
+        lr_s = _sds((), np.dtype(np.float32))
+        mstate = metric.device_init()
+        mstate_s = jax.tree_util.tree_map(
+            lambda x: _sds(tuple(x.shape), np.dtype(x.dtype)), mstate)
+
+        jobs = []
+        for bkey, d, l in programs:
+            data_names_p = list(d)
+            label_names_p = list(l)
+            step = self._get_train_step(
+                bkey, data_names_p, label_names_p, optimizer, mesh,
+                metric=metric if use_device_metric else None,
+                apply_update=True, guard_cfg=guard_cfg,
+                pad_policy=pad_policy)
+            batch_s = {}
+            for name, spec in {**d, **l}.items():
+                shape, dtype = _split(spec)
+                batch_s[name] = _sds(shape, dtype, sharded=True)
+            args = (params_s, opt_state_s, aux_s, batch_s, rng_s, lr_s,
+                    mstate_s)
+            if guard_cfg is not None:
+                args += (guards_mod.init_guard_state(guard_cfg),)
+            if pad_policy is not None:
+                args += (_sds((), np.dtype(np.int32)),)
+            jobs.append((step._tracked, args))
+
+        t0 = time.time()
+        if parallel and len(jobs) > 1:
+            import concurrent.futures as cf
+
+            workers = min(len(jobs), int(os.environ.get(
+                "MXNET_TPU_PRECOMPILE_THREADS", "4")))
+            with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+                futures = [pool.submit(tj.precompile, *args)
+                           for tj, args in jobs]
+                for f in futures:
+                    f.result()
+        else:
+            for tj, args in jobs:
+                tj.precompile(*args)
+        wall = time.time() - t0
+        logging.info("precompile: %d program(s) ready in %.2fs", len(jobs),
+                     wall)
+        return {"programs": len(jobs), "wall_seconds": wall,
+                "labels": [tj.label for tj, _ in jobs]}
 
     @staticmethod
     def _chaos_step_sites(batch_arrays, data_names, watchdog):
@@ -1036,8 +1367,11 @@ class FeedForward(BASE_ESTIMATOR):
         whole XLA program every epoch/predict). One cache entry per bucket
         key — the jit cache is the reference's executor-per-seq-len cache."""
         if bucket_key not in self._pred_fns:
+            label = (f"pred_step:{self._fingerprint_for_bucket(bucket_key)}"
+                     + (f":bucket={bucket_key}" if bucket_key is not None
+                        else ""))
             self._pred_fns[bucket_key] = self._build_pred_step(
-                None, self._symbol_for_bucket(bucket_key))
+                None, self._symbol_for_bucket(bucket_key), label=label)
         return self._pred_fns[bucket_key]
 
     def _get_eval_metric_step(self, bucket_key, eval_metric):
@@ -1063,7 +1397,11 @@ class FeedForward(BASE_ESTIMATOR):
                 return update(mstate, labels,
                               [o.astype(jnp.float32) for o in outs])
 
-            self._eval_fns[key] = jax.jit(estep, donate_argnums=(4,))
+            self._eval_fns[key] = compile_mod.tracked_jit(
+                estep, donate_argnums=(4,),
+                label=(f"eval_step:{self._fingerprint_for_bucket(bucket_key)}"
+                       + (f":bucket={bucket_key}" if bucket_key is not None
+                          else "")))
         return self._eval_fns[key]
 
     def _eval(self, eval_iter, eval_metric, params, aux, data_names, label_names):
@@ -1076,14 +1414,25 @@ class FeedForward(BASE_ESTIMATOR):
         use_device_metric = eval_metric.device_supported
         maccum = self._DeviceMetricAccum(eval_metric) if use_device_metric \
             else None
+        first_rows = {}  # bucket key -> the shape this bucket compiled for
         eval_iter.reset()
         for batch in eval_iter:
             bkey = getattr(batch, "bucket_key", None)
             names = getattr(batch, "data_names", data_names)
             batch_arrays = {name: arr.data for name, arr in zip(names, batch.data)}
+            # tail batches SHORTER than the bucket's compiled shape pad up
+            # (repeat last row) instead of compiling a one-off program; the
+            # extra rows join the pad slice below. Iterators that pad
+            # in-place (NDArrayIter wrap-around) report pad>0 and are
+            # already full-shape.
+            rows = int(next(iter(batch_arrays.values())).shape[0])
+            target = first_rows.setdefault(bkey, rows)
+            extra = target - rows
+            if extra > 0:
+                batch_arrays = _pad_rows_np(batch_arrays, extra)
             batch_arrays = self._batch_to_ctx(self._fill_missing_args(
                 params, batch_arrays, symbol=self._symbol_for_bucket(bkey)))
-            pad = batch.pad
+            pad = batch.pad + max(extra, 0)
             if use_device_metric and pad == 0:
                 # fused forward+metric, no per-batch host pull; padded tail
                 # batches (at most one per epoch) take the host path below
@@ -1096,8 +1445,9 @@ class FeedForward(BASE_ESTIMATOR):
                 continue
             pred = self._get_pred_step(bkey)
             outs = pred(params, aux, batch_arrays)
-            outs = [NDArray(o[: o.shape[0] - pad] if pad else o) for o in outs]
-            labels = [NDArray(l.data[: l.shape[0] - pad] if pad else l.data)
+            nv = rows - batch.pad  # valid rows of the pre-padding batch
+            outs = [NDArray(o[:nv] if nv != o.shape[0] else o) for o in outs]
+            labels = [NDArray(l.data[:nv] if nv != l.shape[0] else l.data)
                       for l in batch.label]
             eval_metric.update(labels, outs)
         if use_device_metric:
@@ -1115,17 +1465,23 @@ class FeedForward(BASE_ESTIMATOR):
         params = {k: v.data for k, v in self.arg_params.items()}
         aux = {k: v.data for k, v in (self.aux_params or {}).items()}
         chunks = None
+        first_rows = {}
         data_iter.reset()
         for batch in data_iter:
             bkey = getattr(batch, "bucket_key", None)
             pred = self._get_pred_step(bkey)
             names = getattr(batch, "data_names", data_names)
             batch_arrays = {name: arr.data for name, arr in zip(names, batch.data)}
+            # pad short tail batches up to the compiled shape (see _eval)
+            rows = int(next(iter(batch_arrays.values())).shape[0])
+            target = first_rows.setdefault(bkey, rows)
+            if target > rows:
+                batch_arrays = _pad_rows_np(batch_arrays, target - rows)
             batch_arrays = self._batch_to_ctx(self._fill_missing_args(
                 params, batch_arrays, symbol=self._symbol_for_bucket(bkey)))
             outs = pred(params, aux, batch_arrays)
-            pad = batch.pad
-            outs = [np.asarray(o[: o.shape[0] - pad] if pad else o) for o in outs]
+            nv = rows - batch.pad
+            outs = [np.asarray(o[:nv] if nv != o.shape[0] else o) for o in outs]
             if chunks is None:
                 chunks = [[] for _ in outs]
             for lst, o in zip(chunks, outs):
@@ -1181,6 +1537,33 @@ class FeedForward(BASE_ESTIMATOR):
                   batch_end_callback=batch_end_callback, kvstore=kvstore,
                   logger=logger, batch_size=batch_size)
         return model
+
+
+def _pad_rows_np(arrays: dict, extra: int) -> dict:
+    """Pad every batch array along axis 0 by repeating the last row
+    ``extra`` times (host-side; eval/predict tail batches — the padded rows
+    are sliced off the outputs, never observed). Delegates to
+    PadPolicy.pad_arrays, the single implementation of row padding."""
+    rows = next(int(v.shape[0]) for v in arrays.values()
+                if getattr(v, "shape", None))
+    return compile_mod.PadPolicy("bucket").pad_arrays(
+        arrays, rows + extra)[0]
+
+
+def _needs_commit(tree, dev):
+    """First-leaf probe: does this state tree need committing to `dev`?
+    State trees move as a unit (all leaves are outputs of the same step, or
+    all fresh host accumulators), so one leaf answers for the tree."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return False
+    first = leaves[0]
+    try:
+        return not (isinstance(first, jax.Array)
+                    and first.devices() == {dev}
+                    and getattr(first, "_committed", True))
+    except Exception:  # pragma: no cover - non-Array leaves
+        return True
 
 
 def _needs_place(tree, mesh):

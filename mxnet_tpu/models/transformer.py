@@ -315,6 +315,6 @@ class TransformerLM:
         template = optimizer.init_state_tree(
             {k: jnp.zeros((2,), jnp.float32) for k in params})
         sshard = self._state_shardings(mesh, template)
-        state = jax.jit(optimizer.init_state_tree,
-                        out_shardings=sshard)(params)
+        state = jax.jit(optimizer.init_state_tree,  # mxlint: disable=MX303
+                        out_shardings=sshard)(params)  # one-shot init
         return params, state

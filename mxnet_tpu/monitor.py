@@ -33,18 +33,37 @@ class Monitor:
     """``track_nonfinite=True`` additionally reports a ``*_nonfinite``
     count per matched internal output and weight, so a tripped step guard
     (resilience.GuardConfig) can be traced to the layer whose activations
-    or gradients blew up instead of being a silent skip counter."""
+    or gradients blew up instead of being a silent skip counter.
+
+    ``track_compiles=True`` folds compile accounting into every ``toc()``:
+    the stat queue gains ``compile/*`` rows (new compile count and
+    compile-seconds since the last collection, from the program registry —
+    utils/compile), so shape drift shows up next to the layer stats it
+    usually corrupts. A RecompileTracker given ``monitor=`` pushes its
+    ``recompile/<program>`` events into the same queue."""
 
     def __init__(self, interval, stat_func=None, pattern=".*",
-                 track_nonfinite=False):
+                 track_nonfinite=False, track_compiles=False):
         self.interval = interval
         self.stat_func = stat_func or (lambda x: np.abs(x).mean())
         self.pattern = re.compile(pattern)
         self.track_nonfinite = track_nonfinite
+        self.track_compiles = track_compiles
         self.step = 0
         self.activated = False
         self.queue = []
         self._exe = None
+        # baseline NOW, not lazily: the first collected window must report
+        # compiles since the monitor was created, not since process start
+        self._compile_snap = None
+        if track_compiles:
+            from .utils import compile as compile_mod
+
+            self._compile_snap = compile_mod.compile_stats()
+        # RecompileTracker(monitor=...) drops events here; drained into the
+        # stat rows at the next toc()/collect_compiles() — appending to
+        # .queue directly would be lost when toc() rebinds it
+        self._recompile_events = []
 
     def install(self, exe):
         """Attach to an Executor (reference: Monitor.install)."""
@@ -81,7 +100,42 @@ class Monitor:
                 if self.track_nonfinite:
                     res.append((self.step, name + "_nonfinite",
                                 nonfinite_count(value)))
+        if self.track_compiles:
+            res.extend(self.collect_compiles())
+        else:
+            res.extend(self._drain_recompiles())
         self.queue = res
+        return res
+
+    def _drain_recompiles(self):
+        events, self._recompile_events = self._recompile_events, []
+        return events
+
+    def collect_compiles(self):
+        """Compile-counter deltas since the last collection, as stat rows:
+        ``compile/count``, ``compile/seconds``, ``compile/jit_misses``, and
+        a per-program ``compile/<label>`` count for any program that
+        compiled in the window (utils/compile registry)."""
+        from .utils import compile as compile_mod
+
+        stats = compile_mod.compile_stats()
+        prev = self._compile_snap or {"compiles": 0, "compile_seconds": 0.0,
+                                      "misses": 0, "per_function": {}}
+        res = [
+            (self.step, "compile/count",
+             stats["compiles"] - prev["compiles"]),
+            (self.step, "compile/seconds",
+             stats["compile_seconds"] - prev["compile_seconds"]),
+            (self.step, "compile/jit_misses",
+             stats["misses"] - prev["misses"]),
+        ]
+        for label, c in stats["per_function"].items():
+            before = prev["per_function"].get(label, {}).get("compiles", 0)
+            if c["compiles"] > before:
+                res.append((self.step, f"compile/{label}",
+                            c["compiles"] - before))
+        res.extend(self._drain_recompiles())
+        self._compile_snap = stats
         return res
 
     def toc_print(self):

@@ -50,6 +50,43 @@ def _softmax_output_bwd(grad_scale, multi_output, res, g):
 _softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
 
 
+def _row_mask(mask, ndim):
+    """(batch,) validity mask broadcast against a (batch, ...) gradient."""
+    return mask.astype(jnp.float32).reshape(mask.shape + (1,) * (ndim - 1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _softmax_output_masked(data, label, mask, grad_scale, multi_output):
+    axis = 1 if (multi_output or data.ndim > 2) else -1
+    return _softmax(data, axis)
+
+
+def _softmax_output_masked_fwd(data, label, mask, grad_scale, multi_output):
+    out = _softmax_output_masked(data, label, mask, grad_scale, multi_output)
+    return out, (out, label, mask)
+
+
+def _softmax_output_masked_bwd(grad_scale, multi_output, res, g):
+    del g  # loss head: out_grad ignored (reference semantics)
+    out, label, mask = res
+    axis = 1 if (multi_output or out.ndim > 2) else -1
+    num_classes = out.shape[axis]
+    onehot = jax.nn.one_hot(
+        label.astype(jnp.int32), num_classes, axis=axis, dtype=jnp.float32
+    )
+    d_data = (out.astype(jnp.float32) - onehot) * grad_scale
+    # padded rows (mask 0) inject NO gradient: parameter grads of a
+    # padded+masked batch equal the unpadded batch exactly (backward is
+    # linear in the injected cotangent)
+    d_data = d_data * _row_mask(mask, d_data.ndim)
+    return (d_data.astype(out.dtype), jnp.zeros_like(label),
+            jnp.zeros_like(mask))
+
+
+_softmax_output_masked.defvjp(_softmax_output_masked_fwd,
+                              _softmax_output_masked_bwd)
+
+
 @register_op("SoftmaxOutput", aliases=["Softmax"])
 class SoftmaxOutputOp(OpProp):
     """Softmax forward + cross-entropy gradient injection (reference:
@@ -76,6 +113,12 @@ class SoftmaxOutputOp(OpProp):
     def fwd(self, ins, aux, is_train, rng):
         return [_softmax_output(ins[0], ins[1], self.grad_scale, self.multi_output)], []
 
+    supports_loss_mask = True
+
+    def fwd_masked(self, ins, aux, is_train, rng, mask):
+        return [_softmax_output_masked(ins[0], ins[1], mask,
+                                       self.grad_scale, self.multi_output)], []
+
 
 def _regression_vjp(transform, grad_fn):
     @jax.custom_vjp
@@ -96,15 +139,47 @@ def _regression_vjp(transform, grad_fn):
     return op
 
 
+def _regression_vjp_masked(transform, grad_fn):
+    """Masked twin of _regression_vjp: padded rows (mask 0) inject no
+    gradient (PadPolicy tail-batch contract, see ops/registry.fwd_masked)."""
+
+    @jax.custom_vjp
+    def op(data, label, mask):
+        return transform(data)
+
+    def fwd(data, label, mask):
+        out = transform(data)
+        return out, (out, label, mask)
+
+    def bwd(res, g):
+        del g
+        out, label, mask = res
+        d = grad_fn(out.astype(jnp.float32),
+                    label.astype(jnp.float32).reshape(out.shape))
+        d = d * _row_mask(mask, d.ndim)
+        return d.astype(out.dtype), jnp.zeros_like(label), jnp.zeros_like(mask)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
 _linear_regression = _regression_vjp(lambda x: x, lambda o, l: o - l)
 _logistic_regression = _regression_vjp(jax.nn.sigmoid, lambda o, l: o - l)
 _mae_regression = _regression_vjp(lambda x: x, lambda o, l: jnp.sign(o - l))
+_linear_regression_masked = _regression_vjp_masked(
+    lambda x: x, lambda o, l: o - l)
+_logistic_regression_masked = _regression_vjp_masked(
+    jax.nn.sigmoid, lambda o, l: o - l)
+_mae_regression_masked = _regression_vjp_masked(
+    lambda x: x, lambda o, l: jnp.sign(o - l))
 
 
 class _RegressionBase(OpProp):
     params = {"grad_scale": (float, 1.0, "gradient multiplier")}
     is_loss = True
+    supports_loss_mask = True
     _kernel = None
+    _kernel_masked = None
 
     def list_arguments(self):
         return ["data", "label"]
@@ -117,6 +192,12 @@ class _RegressionBase(OpProp):
         out = type(self)._kernel(ins[0], ins[1])
         if self.grad_scale != 1.0:
             # fold the scale into the custom vjp via linearity of the grad
+            out = _ScaleGrad(self.grad_scale)(out)
+        return [out], []
+
+    def fwd_masked(self, ins, aux, is_train, rng, mask):
+        out = type(self)._kernel_masked(ins[0], ins[1], mask)
+        if self.grad_scale != 1.0:
             out = _ScaleGrad(self.grad_scale)(out)
         return [out], []
 
@@ -146,6 +227,7 @@ class LinearRegressionOutputOp(_RegressionBase):
     regression_output.cc:31)."""
 
     _kernel = staticmethod(_linear_regression)
+    _kernel_masked = staticmethod(_linear_regression_masked)
 
 
 @register_op("LogisticRegressionOutput")
@@ -154,6 +236,7 @@ class LogisticRegressionOutputOp(_RegressionBase):
     regression_output.cc:36)."""
 
     _kernel = staticmethod(_logistic_regression)
+    _kernel_masked = staticmethod(_logistic_regression_masked)
 
 
 @register_op("MAERegressionOutput")
@@ -162,3 +245,4 @@ class MAERegressionOutputOp(_RegressionBase):
     capability extension in the same family)."""
 
     _kernel = staticmethod(_mae_regression)
+    _kernel_masked = staticmethod(_mae_regression_masked)

@@ -132,6 +132,17 @@ class OpProp:
     def fwd(self, ins, aux, is_train, rng):
         raise NotImplementedError
 
+    # loss-mask support (utils/compile.PadPolicy): loss heads that can zero
+    # padded rows' injected gradients set ``supports_loss_mask = True`` and
+    # implement ``fwd_masked`` — forward identical to ``fwd``, backward
+    # multiplies the injected per-row gradient by ``mask`` (shape (batch,)).
+    supports_loss_mask = False
+
+    def fwd_masked(self, ins, aux, is_train, rng, mask):
+        raise MXNetError(
+            f"{type(self).__name__} does not support loss masking; "
+            "PadPolicy needs a mask-capable loss head (see ops/loss.py)")
+
     def serialize_params(self) -> dict:
         """JSON-able param dict for Symbol save/load."""
         return {k: (list(v) if isinstance(v, tuple) else v) for k, v in self.attr.items()}

@@ -9,7 +9,13 @@ from .memory import memory_stats
 from .profiler import profile_scope, start_trace, stop_trace
 from . import checkpoint
 from .checkpoint import latest_step, load_sharded, save_sharded, validate_step
+from . import compile
+from .compile import (PadPolicy, RecompileError, RecompileTracker,
+                      compile_stats, configure_persistent_cache,
+                      reset_compile_stats, tracked_jit)
 
 __all__ = ["memory_stats", "profile_scope", "start_trace", "stop_trace",
            "checkpoint", "latest_step", "load_sharded", "save_sharded",
-           "validate_step"]
+           "validate_step", "compile", "PadPolicy", "RecompileError",
+           "RecompileTracker", "compile_stats", "configure_persistent_cache",
+           "reset_compile_stats", "tracked_jit"]

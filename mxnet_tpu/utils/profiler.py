@@ -20,7 +20,7 @@ import time
 import jax
 
 __all__ = ["start_trace", "stop_trace", "profile_scope", "Timer",
-           "OpStat", "trace_op_stats", "profile_step"]
+           "OpStat", "trace_op_stats", "profile_step", "compile_report"]
 
 
 def start_trace(log_dir: str):
@@ -102,15 +102,51 @@ def trace_op_stats(log_dir: str, device_substr: str = "", top: int | None = None
     return stats[:top] if top else stats
 
 
+def compile_report(stats: dict | None = None) -> str:
+    """Human-readable compile accounting table: per-function compile counts,
+    compile-seconds, and cache hits/misses from the program registry (see
+    utils/compile.ProgramRegistry — the same counters fit() logs per epoch).
+    """
+    from . import compile as compile_mod
+
+    stats = stats if stats is not None else compile_mod.compile_stats()
+    lines = [
+        f"compiles={stats['compiles']} "
+        f"compile_s={stats['compile_seconds']:.2f} "
+        f"jit_hits={stats['hits']} misses={stats['misses']} "
+        f"persistent_hits={stats['persistent_cache_hits']} "
+        f"saved_s={stats['persistent_cache_saved_seconds']:.2f}"
+    ]
+    per_fn = sorted(stats.get("per_function", {}).items(),
+                    key=lambda kv: -kv[1]["compile_seconds"])
+    for name, c in per_fn:
+        lines.append(
+            f"  {c['compile_seconds']:8.2f}s  x{c['compiles']:<3d} "
+            f"hits={c['hits']:<6d} misses={c['misses']:<3d} "
+            f"programs={c.get('programs', 0):<3d} {name}")
+    return "\n".join(lines)
+
+
 def profile_step(fn, *args, iters: int = 3, log_dir: str | None = None,
-                 top: int | None = 20):
+                 top: int | None = 20, return_compile: bool = False):
     """Trace ``iters`` calls of a (jitted) function and return its op stats.
 
     Convenience wrapper: warms up once, captures a trace, digests it with
     :func:`trace_op_stats`. Returns ``(stats, log_dir)``; ``log_dir``
     defaults to a kept temp dir so the full trace can still be opened in
     the profiler UI.
+
+    Compile accounting rides along: any XLA compiles the profiled window
+    triggered (warmup included) are logged via :func:`compile_report`, and
+    ``return_compile=True`` returns ``(stats, log_dir, compile_delta)``
+    with the raw counter deltas (compile count/seconds, cache hits/misses,
+    persistent-cache traffic) for programmatic use (bench --compile-bench).
     """
+    import logging
+
+    from . import compile as compile_mod
+
+    before = compile_mod.registry().snapshot()
     out = fn(*args)
     jax.block_until_ready(out)
     log_dir = log_dir or tempfile.mkdtemp(prefix="mxtpu_profile_")
@@ -118,4 +154,12 @@ def profile_step(fn, *args, iters: int = 3, log_dir: str | None = None,
         for _ in range(iters):
             out = fn(*args)
         jax.block_until_ready(out)
+    after = compile_mod.registry().snapshot()
+    delta = {k: after[k] - before[k] for k in after}
+    if delta["compiles"]:
+        logging.info("profile_step: %d XLA compile(s), %.2fs, in the "
+                     "profiled window\n%s", delta["compiles"],
+                     delta["compile_seconds"], compile_report())
+    if return_compile:
+        return trace_op_stats(log_dir, top=top), log_dir, delta
     return trace_op_stats(log_dir, top=top), log_dir

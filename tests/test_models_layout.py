@@ -45,7 +45,7 @@ def test_inception_bn_nhwc_matches_nchw():
         params, aux = _init(sym, shapes)  # same seed -> identical OIHW
         graph_fn = _build_graph_fn(sym, is_train=False)
         zero_key = jnp.zeros((2,), jnp.uint32)
-        res, _ = jax.jit(lambda p, a, d: graph_fn(
+        res, _ = jax.jit(lambda p, a, d: graph_fn(  # mxlint: disable=MX303
             {**p, "data": d, "softmax_label": jnp.asarray(label)}, a,
             zero_key))(params, aux, jnp.asarray(data))
         outs[layout] = np.asarray(res[0])

@@ -88,6 +88,69 @@ def test_fixture_static_argnums_list():
     assert _ids(lint_source(src, "fx.py")) == ["MX301"]
 
 
+def test_fixture_mx303_jit_inside_loop():
+    src = (
+        "import jax\n"
+        "def train(batches):\n"
+        "    for b in batches:\n"
+        "        step = jax.jit(lambda x: x * 2)\n"
+        "        step(b)\n"
+    )
+    assert "MX303" in _ids(lint_source(src, "fx.py"))
+
+
+def test_fixture_mx303_immediate_jit_call():
+    src = (
+        "import jax\n"
+        "def f(g, x):\n"
+        "    return jax.jit(g)(x)\n"
+    )
+    findings = lint_source(src, "fx.py")
+    assert _ids(findings) == ["MX303"]
+    assert "fresh jit wrapper" in findings[0].message
+
+
+def test_fixture_mx303_unstable_static_args():
+    src = (
+        "import jax\n"
+        "def g(x, n):\n"
+        "    return x\n"
+        "h = jax.jit(g, static_argnums=list(range(1, 2)))\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == ["MX303"]
+    src2 = (
+        "import jax\n"
+        "def g(x, n):\n"
+        "    return x\n"
+        "h = jax.jit(g, static_argnames=[n for n in ('n',)])\n"
+    )
+    assert _ids(lint_source(src2, "fx.py")) == ["MX303"]
+
+
+def test_fixture_mx303_clean_patterns_pass():
+    """The sanctioned shapes: wrapper cached at module/instance scope,
+    tuple static args — no findings."""
+    src = (
+        "import jax\n"
+        "def g(x, n):\n"
+        "    return x\n"
+        "step = jax.jit(g, static_argnums=(1,))\n"
+        "def train(batches):\n"
+        "    for b in batches:\n"
+        "        step(b, 2)\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+
+
+def test_fixture_mx303_pragma_suppression():
+    src = (
+        "import jax\n"
+        "def f(g, x):\n"
+        "    return jax.jit(g)(x)  # mxlint: disable=MX303\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+
+
 def test_fixture_fstring_in_traced_fn():
     src = (
         "import jax\n"

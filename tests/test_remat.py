@@ -219,7 +219,7 @@ def test_transformer_remat_composes_with_ring_attention():
     def grads(remat):
         lm = TransformerLM(dict(cfg, remat=remat))
         params = lm.init_params(jax.random.PRNGKey(0))
-        return jax.jit(jax.grad(
+        return jax.jit(jax.grad(  # mxlint: disable=MX303
             lambda p: lm.loss(p, tokens, targets, mesh=mesh)))(params)
 
     g0, g1 = grads(False), grads(True)
