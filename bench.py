@@ -484,6 +484,131 @@ def run_compile_bench(args):
     shutil.rmtree(base, ignore_errors=True)
 
 
+def run_comm_bench(args):
+    """Gradient-sync wire bytes + step time per compression mode on the
+    8-virtual-device CPU mesh (the comm subsystem's acceptance rig: real
+    chips aren't needed to measure the collective plan — the compiled
+    HLO's collective instructions ARE the wire). For each mode the same
+    dp-8 MLP train step is built via parallel.make_data_parallel_step,
+    its HLO collective-byte table extracted (comm.hlo_collective_table),
+    cross-checked against the closed-form plan (comm.allreduce_plan), and
+    timed. Emits one JSON line; full runs write BENCH_COMM_r08.json."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu import comm
+    from mxnet_tpu import parallel as par
+
+    ndev = 8
+    devs = jax.devices()
+    if len(devs) < ndev:
+        print(json.dumps({"metric": "comm_bench_int8_wire_reduction_vs_fp32",
+                          "value": 0, "unit": "x", "vs_baseline": 0,
+                          "error": f"need {ndev} devices, have {len(devs)}"}))
+        return
+    mesh = par.make_mesh(dp=ndev, devices=devs[:ndev])
+    smoke = args.smoke
+    dim, hidden, classes = (64, 64, 8) if smoke else (512, 1024, 64)
+    batch = 64 if smoke else 256
+    steps = 3 if smoke else 30
+    rng = np.random.RandomState(0)
+    params0 = {
+        "w1": (rng.randn(dim, hidden) * 0.05).astype(np.float32),
+        "b1": np.zeros(hidden, np.float32),
+        "w2": (rng.randn(hidden, classes) * 0.05).astype(np.float32),
+        "b2": np.zeros(classes, np.float32),
+    }
+    num_elems = sum(v.size for v in params0.values())
+
+    def loss_fn(params, data):
+        h = jnp.tanh(data["x"] @ params["w1"] + params["b1"])
+        logits = h @ params["w2"] + params["b2"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, data["y"][:, None], axis=1))
+
+    lr = 0.1
+
+    def update_fn(params, opt_state, grads):
+        return {k: params[k] - lr * grads[k] for k in params}, opt_state
+
+    x = rng.randn(batch, dim).astype(np.float32)
+    y = rng.randint(0, classes, (batch,)).astype(np.int32)
+    data = par.shard_batch({"x": x, "y": y}, mesh)
+
+    modes = {}
+    for mode in (None, "bf16", "int8", "twobit"):
+        spec = comm.CompressionSpec.resolve(mode)
+        step = par.make_data_parallel_step(loss_fn, update_fn, mesh,
+                                           donate=False, compression=mode)
+        params = par.replicate_params(
+            {k: jnp.asarray(v) for k, v in params0.items()}, mesh)
+        call = (params, {}, data)
+        if spec is not None and spec.error_feedback:
+            resid = jax.device_put(
+                comm.init_error_feedback(params, spec, ndev),
+                NamedSharding(mesh, P("dp")))
+            call += (resid,)
+        hlo = step.lower(*call).compile().as_text()
+        table = comm.hlo_collective_table(hlo, default_group_size=ndev)
+        hlo_wire = sum(r["wire_bytes"] for r in table)
+        plan = comm.allreduce_plan(num_elems, ndev, mode)
+        res = step(*call)  # warm the dispatch path
+        jax.block_until_ready(res[0])
+        state = call
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            res = step(state[0], state[1], data, *state[3:])
+            state = (res[0], res[1], data) + tuple(res[3:])
+        jax.block_until_ready(res[0])
+        dt = (_time.perf_counter() - t0) / steps
+        modes[mode or "none"] = {
+            "hlo_wire_bytes_per_step": round(hlo_wire, 1),
+            "hlo_collectives": table,
+            "plan_wire_bytes_per_step": round(plan["wire_bytes"], 1),
+            "plan_ratio_vs_fp32": round(plan["ratio"], 2),
+            "step_ms": round(dt * 1e3, 3),
+            "final_loss": round(float(np.asarray(res[2])), 5),
+        }
+    fp32_wire = modes["none"]["hlo_wire_bytes_per_step"]
+    for m in modes.values():
+        m["hlo_ratio_vs_fp32"] = round(
+            fp32_wire / m["hlo_wire_bytes_per_step"], 2) \
+            if m["hlo_wire_bytes_per_step"] else None
+    ratio = modes["int8"]["hlo_ratio_vs_fp32"] or 0.0
+    result = {
+        "metric": "comm_bench_int8_wire_reduction_vs_fp32",
+        "value": ratio,
+        "unit": "x",
+        # fp32 IS the baseline: vs_baseline == the reduction factor
+        "vs_baseline": ratio,
+        "axis_size": ndev,
+        "param_elements": num_elems,
+        "smoke": bool(smoke),
+        "modes": modes,
+        "notes": (
+            "hlo_* numbers are from the compiled CPU-mesh HLO: int8/uint8 "
+            "payloads are faithful, but the CPU backend's float "
+            "normalization upcasts bf16 collectives to f32, so bf16 (and "
+            "twobit's bf16 all-gather stage) read high here — plan_* is "
+            "authoritative for those; on TPU bf16 stays bf16. step_ms is "
+            "CPU compute-bound (quantization arithmetic costs more than "
+            "the loopback 'wire' saves); the wire-byte cut is the number "
+            "that transfers to bandwidth-bound pods."),
+    }
+    print(json.dumps(result))
+    if not smoke:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_COMM_r08.json")
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(f"wrote {out}", file=sys.stderr)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=256)
@@ -503,6 +628,14 @@ def main():
                     help="resnet50: headline; inception_bn: the BASELINE "
                          "anchor architecture itself (97 img/s on GTX 980) "
                          "for a same-architecture comparison")
+    ap.add_argument("--comm-bench", action="store_true",
+                    help="gradient-sync wire bytes + step time per "
+                         "compression mode (none/bf16/int8/twobit) on the "
+                         "8-virtual-device CPU mesh; emits "
+                         "BENCH_COMM_r08.json (full run)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="with --comm-bench: tiny shapes, no file written "
+                         "(the CI guard in tests/test_bench_entry.py)")
     ap.add_argument("--compile-bench", action="store_true",
                     help="cold vs warm (persistent compilation cache) "
                          "time-to-first-step + AOT warmup wall time; "
@@ -519,6 +652,18 @@ def main():
     args = ap.parse_args()
     if args.remat:
         os.environ["MXNET_TPU_REMAT"] = args.remat
+
+    if args.comm_bench:
+        # CPU-mesh bench by design (see run_comm_bench): force the cpu
+        # platform + 8 virtual devices BEFORE the first jax import so the
+        # collective plan is inspectable without hardware
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        run_comm_bench(args)
+        return
 
     if args.compile_bench_child:
         # measured subprocess of --compile-bench: no watchdog/probe — the

@@ -87,6 +87,7 @@ from . import bucketing
 from .bucketing import BucketingFeedForward, BucketSentenceIter
 from . import recordio
 from . import parallel
+from . import comm
 from . import models
 from . import utils
 

@@ -36,12 +36,20 @@ HOST_TRANSFER_PRIMS = {
 _INNER_JAXPR_PARAMS = ("jaxpr", "call_jaxpr", "fun_jaxpr", "cond_jaxpr",
                       "body_jaxpr")
 
+# collective primitives: traced-level comm ops (explicit shard_map
+# collectives; the SPMD partitioner's implicit psums only exist post-HLO —
+# comm.stats.hlo_collective_table covers that side)
+COLLECTIVE_PRIMS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                    "all_to_all", "ppermute", "pshuffle", "psum_scatter",
+                    "reduce_scatter"}
+
 
 @dataclass
 class AuditReport:
     findings: list = field(default_factory=list)
     totals: dict = field(default_factory=dict)    # {'flops': .., 'bytes': ..}
     rows: list = field(default_factory=list)      # per-primitive table
+    comm_rows: list = field(default_factory=list)  # per-collective table
 
     @property
     def errors(self):
@@ -128,6 +136,7 @@ def audit_jaxpr(closed_jaxpr, intended_dtype=None) -> AuditReport:
     report = AuditReport()
     jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
     by_prim: dict[str, dict] = {}
+    by_coll: dict[str, dict] = {}
     intended = np.dtype(intended_dtype) if intended_dtype is not None else None
 
     for eqn in _iter_eqns(jaxpr):
@@ -137,6 +146,16 @@ def audit_jaxpr(closed_jaxpr, intended_dtype=None) -> AuditReport:
         row["count"] += 1
         row["flops"] += _eqn_flops(eqn)
         row["bytes"] += _byte_cost(eqn)
+
+        if name in COLLECTIVE_PRIMS:
+            # roofline comm side: payload = operand bytes (what crosses
+            # the axis); feeds the same table shape as the HLO extractor
+            crow = by_coll.setdefault(
+                name, {"op": name, "count": 0, "payload_bytes": 0})
+            crow["count"] += 1
+            crow["payload_bytes"] += sum(
+                _aval_bytes(v.aval) for v in eqn.invars
+                if hasattr(v, "aval"))
 
         if name in HOST_TRANSFER_PRIMS:
             report.findings.append(Finding(
@@ -163,10 +182,14 @@ def audit_jaxpr(closed_jaxpr, intended_dtype=None) -> AuditReport:
 
     report.rows = sorted(by_prim.values(),
                          key=lambda r: r["bytes"], reverse=True)
+    report.comm_rows = sorted(by_coll.values(),
+                              key=lambda r: r["payload_bytes"], reverse=True)
     report.totals = {
         "flops": sum(r["flops"] for r in report.rows),
         "bytes": sum(r["bytes"] for r in report.rows),
         "eqns": sum(r["count"] for r in report.rows),
+        "comm_payload_bytes": sum(r["payload_bytes"]
+                                  for r in report.comm_rows),
     }
     return report
 

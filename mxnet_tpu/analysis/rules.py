@@ -148,6 +148,15 @@ register_rule(
     "hoist jax.jit out of the loop/call and cache the wrapper (e.g. "
     "utils.compile.tracked_jit stored on the instance); pass static args "
     "as stable hashable values, not freshly computed ones")
+register_rule(
+    "MX304", "warning",
+    "raw jax.lax.psum over a gradient pytree outside mxnet_tpu.comm — "
+    "full-precision, unbucketed, unaccounted gradient sync on the hot "
+    "path (the comm subsystem owns that wire)",
+    "route gradient allreduce through mxnet_tpu.comm "
+    "(compressed_allreduce / error_feedback_allreduce) or "
+    "parallel.allreduce_grads, which add quantized wire formats, fused "
+    "bucketing, and comm_stats() byte accounting")
 
 # MX4xx — graph verifier (Symbol.verify)
 register_rule(

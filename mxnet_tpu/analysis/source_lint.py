@@ -124,6 +124,22 @@ _HOST_CAST_FUNCS = ("float", "int", "bool", "complex")
 _SKIP_DIRS = {".git", "__pycache__", ".claude", ".pytest_cache", "node_modules"}
 
 
+def _mentions_grad(node) -> bool:
+    """Does an expression name something gradient-shaped? (MX304 heuristic:
+    identifiers/attributes containing 'grad' — zero-FP over recall.)"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "grad" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "grad" in sub.attr.lower():
+            return True
+    return False
+
+
+def _in_comm_package(path: str) -> bool:
+    """mxnet_tpu/comm is the sanctioned home for raw gradient psums."""
+    return "mxnet_tpu/comm" in path.replace(os.sep, "/")
+
+
 def _dotted(expr, imports):
     """Resolve an expression to a dotted path via the module's import map.
     Returns None when the root name is not an imported module/symbol."""
@@ -236,6 +252,34 @@ class _ModuleScan(ast.NodeVisitor):
                     f"`{inner}(fn)(...)` builds a fresh jit wrapper and "
                     "discards it after one call",
                     path=self.path, line=node.lineno, col=node.col_offset))
+        # MX304: raw psum over gradient-named values — uncompressed,
+        # unbucketed gradient sync outside the comm subsystem. Two shapes:
+        # (a) lax.psum(grads/...) directly; (b) the tree_map(lambda g:
+        # lax.psum(g, ax), grads) idiom, where the lambda's parameter hides
+        # the gradient name but a sibling argument carries it.
+        if not _in_comm_package(self.path):
+            if dotted is not None and dotted.endswith("psum") and node.args \
+                    and _mentions_grad(node.args[0]):
+                self.findings.append(Finding(
+                    get_rule("MX304"),
+                    f"`{dotted}` over a gradient pytree bypasses the comm "
+                    "subsystem (fp32, no bucketing, no wire accounting)",
+                    path=self.path, line=node.lineno, col=node.col_offset))
+            elif dotted is not None and dotted.endswith("tree_map") and \
+                    any(_mentions_grad(a) for a in node.args[1:]):
+                fn_arg = node.args[0] if node.args else None
+                if fn_arg is not None:
+                    for sub in ast.walk(fn_arg):
+                        if isinstance(sub, ast.Call):
+                            inner = _dotted(sub.func, self.imports)
+                            if inner is not None and inner.endswith("psum"):
+                                self.findings.append(Finding(
+                                    get_rule("MX304"),
+                                    f"`{inner}` mapped over a gradient "
+                                    "pytree bypasses the comm subsystem",
+                                    path=self.path, line=sub.lineno,
+                                    col=sub.col_offset))
+                                break
         # MX303(b): a jit wrapper created inside a loop body is re-created
         # (cache lost) on every iteration
         if _is_jit_family(dotted) and self._loop_depth > 0:

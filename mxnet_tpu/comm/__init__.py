@@ -1,0 +1,45 @@
+"""Gradient-communication subsystem: quantized collectives, bucketing,
+and wire-byte accounting.
+
+At scale, data-parallel step time is bounded by gradient sync; every sync
+path in this framework used to move full fp32 with no measurement. This
+package is the one home for gradient communication (mxlint MX304 flags
+raw psums over gradients elsewhere):
+
+  compression.py  CompressionSpec (none|bf16|int8|twobit) + pure
+                  quantize/dequantize kernels, jax and numpy
+  allreduce.py    the in-jit compressed allreduce (quantize ->
+                  reduce-scatter -> dequantize-accumulate -> all-gather)
+                  with error-feedback residuals threaded through the
+                  train-step carry
+  bucketing.py    DDP-style size-capped fused slabs + host codec for the
+                  kvstore transports
+  stats.py        exact wire-byte plans, the process CommRegistry behind
+                  ``comm_stats()``, and compiled-HLO collective extraction
+
+Entry points: ``FeedForward.fit(compression=...)``,
+``parallel.make_data_parallel_step(compression=...)``,
+``KVStore.set_gradient_compression(...)`` (the reference kvstore API),
+``comm.comm_stats()``. Guide: doc/developer-guide/comm.md.
+"""
+
+from .compression import (CompressionSpec, decode, encode, payload_nbytes,
+                          payload_bytes_of, quantization_unit)
+from .allreduce import (compressed_allreduce, error_feedback_allreduce,
+                        init_error_feedback, flat_size, padded_flat_size)
+from .bucketing import (DEFAULT_BUCKET_BYTES, GradBucketer, HostCodec,
+                        decode_payload)
+from .stats import (CommRegistry, allreduce_plan, comm_stats,
+                    fp32_allreduce_wire_bytes, hlo_collective_table,
+                    hlo_collective_wire_bytes, registry, reset_comm_stats)
+
+__all__ = [
+    "CompressionSpec", "encode", "decode", "payload_nbytes",
+    "payload_bytes_of", "quantization_unit",
+    "compressed_allreduce", "error_feedback_allreduce",
+    "init_error_feedback", "flat_size", "padded_flat_size",
+    "GradBucketer", "HostCodec", "decode_payload", "DEFAULT_BUCKET_BYTES",
+    "CommRegistry", "registry", "comm_stats", "reset_comm_stats",
+    "allreduce_plan", "fp32_allreduce_wire_bytes",
+    "hlo_collective_table", "hlo_collective_wire_bytes",
+]

@@ -1,0 +1,228 @@
+"""Gradient-compression kernels: CompressionSpec + pure quantize/dequantize.
+
+Reference lineage: MXNet later shipped 2-bit gradient compression in
+kvstore (``kvstore.set_gradient_compression({'type': '2bit'})``) — worker
+pushes carry {-threshold, 0, +threshold} in 2 bits per element and the
+quantization error is fed back into the next push. EQuARX (arxiv
+2506.17615) shows the same lever inside XLA collectives at block scale.
+This module is the shared kernel layer for both incarnations here:
+
+  - the **in-jit** path (comm/allreduce.py): ``encode``/``decode`` on
+    jax arrays trace into the compiled train step, so the collective's
+    payload is built on device with no host round-trip;
+  - the **host** path (comm/bucketing.py HostCodec): the same math on
+    numpy buffers for the kvstore socket/server transports.
+
+Modes (``CompressionSpec.mode``):
+
+  none    fp32 passthrough (4 bytes/elem on the wire)
+  bf16    round to bfloat16 (2 bytes/elem); lossless exponent, 8-bit
+          mantissa — usually safe without error feedback
+  int8    per-chunk-scaled linear quantization (1 byte/elem + one f32
+          scale per ``chunk`` elems): scale = max|x|/127 over the chunk,
+          q = round(x/scale) ∈ [-127, 127]
+  twobit  threshold ternarization, the reference's 2-bit scheme:
+          x > t → +t, x < -t → -t, else 0 — four values packed per byte
+          (0.25 bytes/elem)
+
+int8/twobit are lossy enough to need **error feedback** (the residual
+x - decode(encode(x)) is added into the next step's gradient before
+quantizing), which `comm.allreduce` threads through the train-step carry;
+``CompressionSpec.error_feedback`` says whether a mode wants it.
+
+All kernels take an ``xp`` module (jax.numpy in-jit, numpy on host) so the
+two paths cannot drift numerically.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["CompressionSpec", "encode", "decode", "payload_nbytes",
+           "payload_bytes_of", "quantization_unit"]
+
+_OFF_VALUES = ("", "0", "off", "false", "no", "none")
+_ON_VALUES = ("1", "on", "true", "yes")
+
+# MXNet spelling ('2bit') and common synonyms
+_MODE_ALIASES = {"2bit": "twobit", "fp32": "none", "float32": "none",
+                 "bfloat16": "bf16", "fp16": "bf16"}
+
+_BITS = {"none": 32, "bf16": 16, "int8": 8, "twobit": 2}
+
+
+def _bf16_dtype(xp):
+    """bfloat16 for either array module (numpy needs ml_dtypes, which jax
+    already depends on)."""
+    if hasattr(xp, "bfloat16"):
+        return xp.bfloat16
+    import ml_dtypes
+
+    return ml_dtypes.bfloat16
+
+
+class CompressionSpec:
+    """What crosses the wire during gradient sync.
+
+    ``mode``: none | bf16 | int8 | twobit (see module docstring).
+    ``threshold``: the twobit ternarization threshold t.
+    ``chunk``: int8 scaling-block size (elements per f32 scale); must be a
+    multiple of 4 so one padded layout serves both int8 and twobit.
+    """
+
+    MODES = ("none", "bf16", "int8", "twobit")
+
+    def __init__(self, mode="none", threshold=0.5, chunk=256):
+        mode = _MODE_ALIASES.get(str(mode).lower(), str(mode).lower())
+        if mode not in self.MODES:
+            raise MXNetError(
+                f"compression mode must be one of {self.MODES}, got {mode!r}")
+        self.mode = mode
+        self.threshold = float(threshold)
+        self.chunk = int(chunk)
+        if self.chunk <= 0 or self.chunk % 4:
+            raise MXNetError("compression chunk must be a positive "
+                             "multiple of 4")
+        if mode == "twobit" and self.threshold <= 0:
+            raise MXNetError("twobit compression needs threshold > 0")
+
+    def __repr__(self):
+        return (f"CompressionSpec(mode={self.mode!r}, "
+                f"threshold={self.threshold}, chunk={self.chunk})")
+
+    def key(self):
+        """Hashable identity (train-program cache key component)."""
+        return ("compression", self.mode, self.threshold, self.chunk)
+
+    @property
+    def error_feedback(self) -> bool:
+        """Lossy enough that the residual must re-enter the next step."""
+        return self.mode in ("int8", "twobit")
+
+    def bits(self) -> int:
+        return _BITS[self.mode]
+
+    @classmethod
+    def resolve(cls, value):
+        """Normalize a user-facing ``compression`` argument.
+
+        None -> env gate ``MXNET_TPU_GRAD_COMPRESSION`` (unset/falsy = off,
+        truthy = int8, else the mode name); True -> int8; str -> that mode;
+        a dict uses the reference kvstore spelling
+        ``{'type': '2bit', 'threshold': 0.5}``; a spec passes through.
+        Returns None (off) or a CompressionSpec with mode != 'none'.
+        """
+        if value is None:
+            raw = os.environ.get("MXNET_TPU_GRAD_COMPRESSION", "")
+            raw = raw.strip().lower()
+            if raw in _OFF_VALUES:
+                return None
+            value = "int8" if raw in _ON_VALUES else raw
+        if value is False:
+            return None
+        if value is True:
+            value = "int8"
+        if isinstance(value, dict):
+            kw = dict(value)
+            mode = kw.pop("type", kw.pop("mode", "none"))
+            spec = cls(mode, **kw)
+        elif isinstance(value, cls):
+            spec = value
+        else:
+            spec = cls(str(value))
+        return None if spec.mode == "none" else spec
+
+
+def quantization_unit(spec: CompressionSpec) -> int:
+    """Flat-vector length granularity a mode needs (callers pad to it):
+    int8 scales per ``chunk`` elems; twobit packs 4 elems per byte."""
+    if spec.mode == "int8":
+        return spec.chunk
+    if spec.mode == "twobit":
+        return 4
+    return 1
+
+
+def encode(spec: CompressionSpec, x, xp=None):
+    """Quantize ``x`` (float, last-axis length a multiple of
+    ``quantization_unit``) into a dict of wire arrays. Pure/traceable."""
+    if xp is None:
+        import jax.numpy as jnp
+
+        xp = jnp
+    x = x.astype(xp.float32)
+    if spec.mode == "none":
+        return {"q": x}
+    if spec.mode == "bf16":
+        return {"q": x.astype(_bf16_dtype(xp))}
+    m = x.shape[-1]
+    if spec.mode == "int8":
+        if m % spec.chunk:
+            raise MXNetError(f"int8 encode: last axis {m} not a multiple "
+                             f"of chunk {spec.chunk}")
+        xr = x.reshape(x.shape[:-1] + (m // spec.chunk, spec.chunk))
+        scale = xp.maximum(xp.max(xp.abs(xr), axis=-1) / 127.0, 1e-30)
+        scale = scale.astype(xp.float32)
+        q = xp.clip(xp.round(xr / scale[..., None]), -127, 127)
+        return {"q": q.astype(xp.int8).reshape(x.shape), "scale": scale}
+    # twobit: codes 0 -> 0, 1 -> +t, 2 -> -t; four codes per byte.
+    # Inclusive boundary: a gradient of exactly +/-t transmits as itself
+    if m % 4:
+        raise MXNetError(f"twobit encode: last axis {m} not a multiple of 4")
+    t = spec.threshold
+    c = (xp.where(x >= t, 1, 0) + xp.where(x <= -t, 2, 0)).astype(xp.uint8)
+    c4 = c.reshape(x.shape[:-1] + (m // 4, 4))
+    packed = (c4[..., 0] | (c4[..., 1] << 2) | (c4[..., 2] << 4)
+              | (c4[..., 3] << 6))
+    return {"q": packed.astype(xp.uint8)}
+
+
+def decode(spec: CompressionSpec, payload, xp=None):
+    """Inverse of :func:`encode`, back to float32 (same shape encode saw)."""
+    if xp is None:
+        import jax.numpy as jnp
+
+        xp = jnp
+    q = payload["q"]
+    if spec.mode in ("none", "bf16"):
+        return q.astype(xp.float32)
+    if spec.mode == "int8":
+        scale = payload["scale"]
+        m = q.shape[-1]
+        qr = q.astype(xp.float32).reshape(
+            q.shape[:-1] + (m // spec.chunk, spec.chunk))
+        return (qr * scale[..., None]).astype(xp.float32).reshape(q.shape)
+    # twobit unpack
+    t = spec.threshold
+    codes = xp.stack([(q >> s) & 3 for s in (0, 2, 4, 6)], axis=-1)
+    vals = xp.where(codes == 1, t, 0.0) + xp.where(codes == 2, -t, 0.0)
+    return vals.astype(xp.float32).reshape(q.shape[:-1] + (q.shape[-1] * 4,))
+
+
+def payload_nbytes(spec: CompressionSpec, num_elements: int) -> int:
+    """Wire bytes of an encoded ``num_elements``-long f32 vector — static
+    math (shapes are trace-time constants), used by the comm plan."""
+    n = int(num_elements)
+    if spec.mode == "none":
+        return 4 * n
+    if spec.mode == "bf16":
+        return 2 * n
+    if spec.mode == "int8":
+        return n + 4 * (n // spec.chunk)
+    return n // 4
+
+
+def payload_bytes_of(payload: dict) -> int:
+    """Actual byte count of an encoded payload dict. Bookkeeping entries
+    (underscore-prefixed, e.g. the ``_n`` length marker) don't cross the
+    wire as tensor payload and are excluded here — one rule, one place."""
+    total = 0
+    for k, v in payload.items():
+        if k.startswith("_"):
+            continue
+        total += int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+    return total

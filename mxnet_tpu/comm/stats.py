@@ -1,0 +1,275 @@
+"""Wire-byte accounting: comm plans, the process registry, HLO extraction.
+
+Three complementary sources of truth:
+
+  1. **Plan arithmetic** (:func:`allreduce_plan`) — the compressed
+     allreduce's shapes are trace-time constants, so its payload and wire
+     bytes are exact closed-form numbers, available before anything
+     compiles. Wire bytes use the standard ring-algorithm factors:
+
+         all-reduce          2·(n-1)/n · payload     (reduce-scatter +
+                                                      all-gather phases)
+         all-gather          (n-1)/n · output bytes
+         reduce-scatter      (n-1)/n · input bytes
+         all-to-all          (n-1)/n · payload
+         collective-permute  1 · payload
+
+  2. **The CommRegistry** — per-program plans plus per-step dispatch
+     counters, so ``comm_stats()`` answers "how many bytes crossed the
+     wire this epoch, at what ratio vs fp32" for the whole process (the
+     compile-registry pattern from utils/compile applied to comm).
+
+  3. **HLO extraction** (:func:`hlo_collective_table`) — parse the
+     compiled program's collective instructions (opcode, operand shapes,
+     replica groups) into the same row shape, applying the same wire
+     factors. This is the cross-check: the plan says what we built, the
+     HLO says what XLA actually lowered (extends the test_comm_plan.py
+     machinery; bench --comm-bench asserts the two agree).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+from .compression import CompressionSpec, payload_nbytes, quantization_unit
+
+__all__ = ["allreduce_plan", "fp32_allreduce_wire_bytes",
+           "CommRegistry", "registry", "comm_stats", "reset_comm_stats",
+           "hlo_collective_table", "hlo_collective_wire_bytes"]
+
+
+# -- plan arithmetic -----------------------------------------------------------
+
+def fp32_allreduce_wire_bytes(num_elements: int, axis_size: int) -> float:
+    """Ring all-reduce wire cost of the uncompressed baseline."""
+    n = int(axis_size)
+    return 2.0 * (n - 1) / n * 4.0 * int(num_elements)
+
+
+def allreduce_plan(num_elements: int, axis_size: int,
+                   compression=None) -> dict:
+    """Exact per-step comm plan for one fused gradient allreduce.
+
+    Returns ``{"collectives": [rows], "payload_bytes", "wire_bytes",
+    "fp32_wire_bytes", "ratio", ...}`` where each row is
+    ``{"op", "count", "payload_bytes", "wire_bytes"}`` and ``ratio`` is
+    fp32-wire / this-wire (>1 = the compression saves bytes).
+    """
+    n = int(axis_size)
+    L = int(num_elements)
+    spec = CompressionSpec.resolve(compression)
+    fp32_wire = fp32_allreduce_wire_bytes(L, n)
+    if spec is None:
+        rows = [{"op": "all-reduce", "count": 1, "payload_bytes": 4 * L,
+                 "wire_bytes": fp32_wire}]
+        mode = "none"
+    else:
+        unit = quantization_unit(spec) * n
+        Lp = -(-L // unit) * unit
+        per = Lp // n
+        p1 = payload_nbytes(spec, Lp)             # stage-1 rows, all devices
+        gspec = CompressionSpec("bf16") if spec.mode == "twobit" else spec
+        p2 = payload_nbytes(gspec, per)           # stage-2 reduced shard
+        rows = [
+            {"op": "all-to-all", "count": 1, "payload_bytes": p1,
+             "wire_bytes": (n - 1) / n * p1},
+            {"op": "all-gather", "count": 1, "payload_bytes": n * p2,
+             "wire_bytes": (n - 1) * p2},
+        ]
+        mode = spec.mode
+    payload = sum(r["payload_bytes"] for r in rows)
+    wire = sum(r["wire_bytes"] for r in rows)
+    return {
+        "mode": mode, "num_elements": L, "axis_size": n,
+        "collectives": rows, "payload_bytes": payload, "wire_bytes": wire,
+        "fp32_wire_bytes": fp32_wire,
+        "ratio": fp32_wire / wire if wire else float("inf"),
+    }
+
+
+# -- process-wide registry -----------------------------------------------------
+
+class CommRegistry:
+    """Per-program comm plans + per-step wire counters (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with getattr(self, "_lock", threading.Lock()):
+            self._plans = {}
+            self._steps = {}
+            self._extra_bytes = {"sent": 0.0, "received": 0.0}
+
+    def register_plan(self, label: str, plan: dict):
+        with self._lock:
+            self._plans[label] = dict(plan)
+            self._steps.setdefault(label, 0)
+
+    def record_step(self, label: str, count: int = 1):
+        """One (or ``count``) dispatches of ``label``'s per-step plan."""
+        with self._lock:
+            self._steps[label] = self._steps.get(label, 0) + int(count)
+
+    def record_host_bytes(self, sent=0, received=0):
+        """Fold host-transport traffic (kvstore sockets) into the totals."""
+        with self._lock:
+            self._extra_bytes["sent"] += int(sent)
+            self._extra_bytes["received"] += int(received)
+
+    def snapshot(self) -> dict:
+        """Cheap totals copy for before/after diffing (epoch logs)."""
+        with self._lock:
+            steps = sum(self._steps.values())
+            wire = sum(self._steps.get(k, 0) * p["wire_bytes"]
+                       for k, p in self._plans.items())
+            fp32 = sum(self._steps.get(k, 0) * p["fp32_wire_bytes"]
+                       for k, p in self._plans.items())
+            host = self._extra_bytes["sent"] + self._extra_bytes["received"]
+            return {"steps": steps, "wire_bytes": wire + host,
+                    "fp32_wire_bytes": fp32, "host_bytes": host}
+
+    def stats(self) -> dict:
+        with self._lock:
+            per = {}
+            for label, plan in self._plans.items():
+                steps = self._steps.get(label, 0)
+                per[label] = {**plan, "steps": steps,
+                              "total_wire_bytes": steps * plan["wire_bytes"]}
+            steps = sum(self._steps.values())
+            wire = sum(c["total_wire_bytes"] for c in per.values())
+            fp32 = sum(self._steps.get(k, 0) * p["fp32_wire_bytes"]
+                       for k, p in self._plans.items())
+            host = dict(self._extra_bytes)
+            total = wire + host["sent"] + host["received"]
+            return {
+                "steps": steps,
+                "wire_bytes": total,
+                "collective_wire_bytes": wire,
+                "fp32_wire_bytes": fp32,
+                "ratio": (fp32 / wire) if wire else None,
+                "host_bytes": host,
+                "per_program": per,
+            }
+
+
+_REGISTRY = None
+
+
+def registry() -> CommRegistry:
+    global _REGISTRY
+    if _REGISTRY is None:
+        _REGISTRY = CommRegistry()
+    return _REGISTRY
+
+
+def comm_stats() -> dict:
+    """Process-wide wire accounting (see CommRegistry)."""
+    return registry().stats()
+
+
+def reset_comm_stats():
+    registry().reset()
+
+
+# -- HLO extraction ------------------------------------------------------------
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1,
+                "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+                "f32": 4, "s32": 4, "u32": 4,
+                "f64": 8, "s64": 8, "u64": 8}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                "collective-permute")
+
+# "%name = <result-shape> <opcode>(..." — result shape may be a tuple;
+# async variants appear as <opcode>-start (skip -done: same traffic)
+_INSTR_RE = re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s+(" + "|".join(_COLLECTIVES) + r")(-start)?\(")
+_SHAPE_RE = re.compile(r"(pred|bf16|f16|f32|f64|s8|u8|s16|u16|s32|u32|s64|"
+                       r"u64)\[([\d,]*)\]")
+_GROUPS_RE = re.compile(r"replica_groups=\{\{([^}]*)\}")
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+
+
+def _shape_bytes(shape_str: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        n = 1
+        for d in filter(None, dims.split(",")):
+            n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _group_size(line: str, default: int) -> int:
+    m = _GROUPS_RE.search(line)
+    if m:
+        ids = [g for g in m.group(1).split(",") if g.strip()]
+        return max(len(ids), 1)
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:  # iota form [num_groups, group_size]<=[...]
+        return max(int(m.group(2)), 1)
+    return default
+
+
+def _wire_bytes(op: str, result_bytes: int, n: int) -> float:
+    if n <= 1:
+        return 0.0
+    if op == "all-reduce":
+        return 2.0 * (n - 1) / n * result_bytes
+    if op == "all-gather":          # result is the full gathered buffer
+        return (n - 1) / n * result_bytes
+    if op == "reduce-scatter":      # result is one shard; input was n shards
+        return float((n - 1) * result_bytes)
+    if op == "all-to-all":
+        return (n - 1) / n * result_bytes
+    return float(result_bytes)      # collective-permute
+
+
+def hlo_collective_table(hlo_text: str, default_group_size: int = 1) -> list:
+    """Parse compiled HLO into per-opcode collective byte rows.
+
+    Each row: ``{"op", "count", "payload_bytes", "wire_bytes"}`` — payload
+    is the summed result-shape bytes of every instance; wire applies the
+    ring factors above with the instruction's replica-group size
+    (``default_group_size`` when the HLO names no groups). ``-start``
+    async variants count once; ``-done`` halves are skipped.
+    """
+    by_op: dict[str, dict] = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.search(line)
+        if not m:
+            continue
+        shape_str, op = m.group(1), m.group(2)
+        if m.group(3) and shape_str.startswith("("):
+            # async -start: result is a tuple aliasing operand and result
+            # buffers; the op's logical result is the LARGEST member
+            # (== result for all-gather, == either for all-reduce) except
+            # for reduce-scatter, whose result is the small shard
+            shapes = [_shape_bytes(s) for s in
+                      re.findall(r"(?:pred|bf16|f16|f32|f64|s8|u8|s16|u16|"
+                                 r"s32|u32|s64|u64)\[[\d,]*\]\S*", shape_str)]
+            if shapes:
+                payload = min(shapes) if op == "reduce-scatter" \
+                    else max(shapes)
+            else:
+                payload = _shape_bytes(shape_str) // 2
+        else:
+            payload = _shape_bytes(shape_str)
+        n = _group_size(line, default_group_size)
+        row = by_op.setdefault(op, {"op": op, "count": 0,
+                                    "payload_bytes": 0, "wire_bytes": 0.0})
+        row["count"] += 1
+        row["payload_bytes"] += payload
+        row["wire_bytes"] += _wire_bytes(op, payload, n)
+    return sorted(by_op.values(), key=lambda r: -r["wire_bytes"])
+
+
+def hlo_collective_wire_bytes(hlo_text: str,
+                              default_group_size: int = 1) -> float:
+    """Total wire bytes of every collective in a compiled HLO module."""
+    return sum(r["wire_bytes"] for r in
+               hlo_collective_table(hlo_text, default_group_size))

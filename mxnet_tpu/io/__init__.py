@@ -21,6 +21,7 @@ these from the process topology.
 
 from __future__ import annotations
 
+import collections
 import gzip
 import logging
 import os
@@ -718,7 +719,7 @@ class ImageRecordIter(DataIter):
         if self.shuffle:
             self._rng.shuffle(self._order)
         self.cursor = 0
-        self._pending = []
+        self._pending = collections.deque()
         self._pad = 0
         for _ in range(self._prefetch_depth):
             self._enqueue()
@@ -938,7 +939,7 @@ class ImageRecordIter(DataIter):
                              [array(labels)], pad=pad)
         if not self._pending:
             raise StopIteration
-        fut = self._pending.pop(0)
+        fut = self._pending.popleft()
         data, labels, pad = fut.result()
         self._enqueue()
         self._pad = pad
@@ -1018,7 +1019,9 @@ class PrefetchingIter(DataIter):
         self._iter = iter_
         self.batch_size = iter_.batch_size
         self._depth = depth or env_int("MXNET_PREFETCH_BUFFER", 4)
-        self._queue = []
+        # deque, not list: next() pops from the head every batch, and
+        # list.pop(0) is O(queue) per pop (O(n·depth) per epoch)
+        self._queue = collections.deque()
         self._exhausted = True
         # serialize producer tasks: the wrapped iterator is stateful, so all
         # next() calls take a write dependency on this engine variable
@@ -1031,7 +1034,7 @@ class PrefetchingIter(DataIter):
                 fut.result()
             except StopIteration:
                 pass
-        self._queue = []
+        self._queue.clear()
         self._iter.reset()
         self._exhausted = False
         for _ in range(self._depth):
@@ -1044,7 +1047,7 @@ class PrefetchingIter(DataIter):
 
     def next(self):
         while self._queue:
-            fut = self._queue.pop(0)
+            fut = self._queue.popleft()
             try:
                 batch = fut.result()
             except StopIteration:

@@ -27,10 +27,22 @@ with true accumulate-until-N + barrier semantics — the single-host stand-in
 for the reference's `dmlc_local.py -n N` multi-process test harness, used by
 the ported dist_sync semantics tests.
 
-Priorities are accepted and ignored: XLA's async runtime and collective
-scheduler own op ordering (reference used priorities to overlap layer-k
-gradient sync with layer-k+1 backward; XLA latency-hiding achieves this
-inside the compiled step).
+Priorities: every data-plane method (``push``/``pull`` and the batched
+``push_many``/``pull_many``/``push_pull`` variants, across KVStore,
+AsyncKVStore, and RetryingKVStore) accepts ``priority=`` uniformly and
+ignores it: XLA's async runtime and collective scheduler own op ordering
+(reference used priorities to overlap layer-k gradient sync with layer-k+1
+backward; XLA latency-hiding achieves this inside the compiled step).
+
+Gradient compression (reference:
+``kvstore.set_gradient_compression({'type': '2bit', ...})``):
+:meth:`KVStore.set_gradient_compression` arms the comm/ host codec so
+worker pushes cross the transport quantized (bf16/int8/twobit with
+error feedback) — wired through the in-process group server here and the
+dist_async socket protocol (kvstore_async.py); the dist_sync host
+collective additionally fuses per-key traffic into size-capped buckets
+(:meth:`_DistKVStore.push_bucketed`). The in-jit psum fast path has its
+own compressed allreduce (comm/allreduce.py, ``fit(compression=...)``).
 """
 
 from __future__ import annotations
@@ -54,6 +66,19 @@ class KVStore:
         self.type = kv_type
         self._store: dict = {}
         self._updater = None
+        self._compression = None  # comm.CompressionSpec, set_gradient_compression
+
+    def set_gradient_compression(self, compression):
+        """Arm gradient compression for this store's transport (reference:
+        kvstore.set_gradient_compression; accepts the same dict spelling
+        ``{'type': '2bit', 'threshold': 0.5}``, a mode name, or a
+        comm.CompressionSpec). In-process stores have no wire, so the base
+        class only records the spec; transports with real traffic (group
+        server, dist_async sockets) encode pushes with it."""
+        from .comm import CompressionSpec
+
+        self._compression = CompressionSpec.resolve(compression)
+        return self._compression
 
     # -- helpers --------------------------------------------------------------
     @staticmethod
@@ -192,6 +217,24 @@ class _DistKVStore(KVStore):
         self._nproc = jax.process_count()
         self._mesh = None
         self._allreduce_cache: dict = {}
+        self._bucketer = None       # (key tuple, GradBucketer)
+
+    def set_gradient_compression(self, compression):
+        """dist_sync's collective SUMS on the wire, so only a dtype-level
+        compression composes with it: bf16 halves the allreduce payload
+        and accumulation stays f32. int8/twobit need the decode-accumulate
+        decomposition — use the in-jit path (``fit(compression=...)``) or
+        ``dist_async``, whose server decodes before applying."""
+        from .comm import CompressionSpec
+
+        spec = CompressionSpec.resolve(compression)
+        if spec is not None and spec.mode != "bf16":
+            raise MXNetError(
+                f"dist_sync supports bf16 wire compression only, got "
+                f"{spec.mode!r}; use fit(compression=...) (in-jit) or "
+                f"kvstore='dist_async' for quantized pushes")
+        self._compression = spec
+        return spec
 
     @property
     def rank(self):
@@ -237,7 +280,9 @@ class _DistKVStore(KVStore):
         key = (x.shape, str(x.dtype))
         fn = self._allreduce_cache.get(key)
         if fn is None:
-            fn = jax.jit(lambda g: jnp.sum(g, axis=0),
+            # accumulate in f32 regardless of wire dtype: bf16 slabs from
+            # push_bucketed must not also accumulate in bf16
+            fn = jax.jit(lambda g: jnp.sum(g.astype(jnp.float32), axis=0),
                          out_shardings=NamedSharding(mesh, P()))
             self._allreduce_cache[key] = fn
         # assemble the global array straight from the device-resident local
@@ -257,6 +302,46 @@ class _DistKVStore(KVStore):
             if k not in self._store:
                 raise MXNetError(f"key {k!r} not initialized")
             merged = self._global_sum(self._merge(vlist))
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                merged.copyto(self._store[k])
+
+    def _bucketer_for(self, arrays: dict):
+        sig = tuple(sorted(arrays))
+        if self._bucketer is None or self._bucketer[0] != sig:
+            from .comm import GradBucketer
+
+            self._bucketer = (sig, GradBucketer(
+                [(k, tuple(arrays[k].shape)) for k in sorted(arrays)]))
+        return self._bucketer[1]
+
+    def push_bucketed(self, kvs: dict, priority=0):
+        """Push a whole gradient dict as size-capped fused slabs: ONE
+        global sum per ~4 MB bucket instead of one per key (DDP-style —
+        a ResNet's ~270 per-key allreduces become ~25, and each dodges the
+        per-call dispatch/jit-lookup overhead). ``kvs`` maps key ->
+        NDArray or a per-device NDArray list (merged like ``push``). With
+        bf16 compression armed (set_gradient_compression) the slab
+        crosses the wire as bf16 and accumulates in f32."""
+        del priority
+        arrays = {}
+        for k, v in kvs.items():
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            arrays[k] = self._merge(v).asnumpy()
+        bucketer = self._bucketer_for(arrays)
+        slabs = bucketer.pack(arrays)
+        for name, flat in slabs.items():
+            if self._compression is not None:  # bf16 wire (see setter)
+                import ml_dtypes
+
+                flat = flat.astype(ml_dtypes.bfloat16)
+            reduced = self._global_sum(NDArray(flat))
+            slabs[name] = reduced.asnumpy().astype(np.float32)
+        summed = bucketer.unpack(slabs)
+        for k, v in summed.items():
+            merged = NDArray(v)
             if self._updater is not None:
                 self._updater(k, merged, self._store[k])
             else:
@@ -307,6 +392,27 @@ class _GroupServer:
         self.duplicate_count = 0
         self._barrier_count = 0
         self._barrier_round = 0
+        # compressed-push accounting: what arrived vs what fp32 would cost
+        self.wire_bytes_received = 0
+        self.raw_bytes_received = 0
+
+    def _decode_value(self, key, value):
+        """Workers with compression armed push ('enc', spec-args, payload)
+        envelopes (see _GroupWorkerKVStore.push); decode to the stored
+        shape and account the wire traffic. Plain ndarrays pass through."""
+        if not (isinstance(value, tuple) and len(value) == 3
+                and value[0] == "enc"):
+            self.raw_bytes_received += getattr(value, "nbytes", 0)
+            self.wire_bytes_received += getattr(value, "nbytes", 0)
+            return value
+        from .comm import (CompressionSpec, decode_payload,
+                           payload_bytes_of)
+
+        _, spec_args, payload = value
+        self.wire_bytes_received += payload_bytes_of(payload)
+        flat = decode_payload(CompressionSpec(*spec_args), payload)
+        self.raw_bytes_received += flat.nbytes
+        return flat.reshape(self.store[key].shape)
 
     def init(self, key, value: np.ndarray):
         with self.lock:
@@ -315,6 +421,7 @@ class _GroupServer:
 
     def push(self, key, value: np.ndarray, worker=None, seq=None):
         with self.cv:
+            value = self._decode_value(key, value)
             my_round = self._round.get(key, 0)
             if worker is not None:
                 prev = self._applied.get((key, worker))
@@ -382,6 +489,20 @@ class _GroupWorkerKVStore(KVStore):
         self._rank = rank
         self._push_seq: dict = {}  # key -> next sequence number
         self._retry_policy = None  # built lazily (rank-seeded jitter)
+        self._codec = None         # HostCodec, armed by compression
+
+    def set_gradient_compression(self, compression):
+        spec = super().set_gradient_compression(compression)
+        self._codec = None  # rebuilt (fresh residuals) on next push
+        return spec
+
+    def compression_stats(self) -> dict:
+        """Worker-side wire accounting for the compressed push path."""
+        if self._codec is None:
+            return {"bytes_raw": 0, "bytes_encoded": 0, "ratio": 1.0}
+        return {"bytes_raw": self._codec.bytes_raw,
+                "bytes_encoded": self._codec.bytes_encoded,
+                "ratio": self._codec.ratio}
 
     @property
     def rank(self):
@@ -414,6 +535,20 @@ class _GroupWorkerKVStore(KVStore):
         for k, vlist in self._as_pairs(key, value):
             merged = self._merge(vlist)
             value_np = merged.asnumpy()
+            if self._compression is not None:
+                # quantize the push (reference: 2-bit gc on worker->server
+                # traffic). The error-feedback residual is folded in at
+                # encode time, so a chaos-retry RESENDS the same payload —
+                # the residual must not be re-applied for a resend, and it
+                # isn't: the envelope below is captured once per seq.
+                from .comm import HostCodec
+
+                if self._codec is None:
+                    self._codec = HostCodec(self._compression)
+                spec = self._compression
+                value_np = ("enc",
+                            (spec.mode, spec.threshold, spec.chunk),
+                            self._codec.encode(k, value_np.ravel()))
             seq = self._push_seq[k] = self._push_seq.get(k, -1) + 1
 
             def attempt(k=k, value_np=value_np, seq=seq):
@@ -462,11 +597,17 @@ def create(kv_type="local") -> KVStore:
     raise MXNetError(f"unknown kvstore type {kv_type!r}")
 
 
-def create_group(num_workers: int, kv_type="dist_sync"):
+def create_group(num_workers: int, kv_type="dist_sync", compression=None):
     """N worker handles sharing one BSP server (single-host stand-in for the
     reference's `dmlc_local.py -n N` multi-process launcher; run each handle
-    from its own thread)."""
+    from its own thread). ``compression`` arms quantized pushes on every
+    worker (each keeps its own error-feedback residuals; the server
+    decodes and accumulates in f32 — see set_gradient_compression)."""
     if kv_type not in ("dist_sync", "dist"):
         raise MXNetError("create_group supports dist_sync semantics")
     server = _GroupServer(num_workers)
-    return [_GroupWorkerKVStore(server, r) for r in range(num_workers)]
+    workers = [_GroupWorkerKVStore(server, r) for r in range(num_workers)]
+    if compression is not None:
+        for w in workers:
+            w.set_gradient_compression(compression)
+    return workers

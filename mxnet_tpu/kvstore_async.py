@@ -183,6 +183,12 @@ class _AsyncServer:
         self._barrier_count = 0
         self._barrier_round = 0
         self._stopped = 0
+        self._compression = None   # last armed spec (informational; *_enc
+                                   # requests carry their own spec)
+        self._layouts: dict = {}   # layout hash -> bucket layout (cached
+                                   # once; per-push resends would be waste)
+        self.wire_bytes_received = 0  # encoded payload bytes accepted
+        self.raw_bytes_received = 0   # f32 bytes those payloads replaced
         # total push REQUESTS applied on arrival: one per push_many/
         # push_pull batch, one per key for the legacy single-key push op
         self.update_count = 0
@@ -307,6 +313,69 @@ class _AsyncServer:
             # serialize + send OUTSIDE the lock: other workers' syncs must
             # not stall behind this connection's socket write
             _send_msg(conn, ("ok", value))
+        elif op == "set_compression":
+            from .comm import CompressionSpec
+
+            with self.lock:
+                self._compression = CompressionSpec(*msg[1]) \
+                    if msg[1] is not None else None
+            _send_msg(conn, ("ok",))
+        elif op in ("push_many_enc", "push_pull_enc"):
+            # compressed + bucketed batch push: quantized slab payloads
+            # (comm/bucketing.py), decoded with the spec CARRIED IN THE
+            # REQUEST (a server-global spec would mis-decode when workers
+            # arm different/changed specs), unpacked via a layout the
+            # client ships ONCE per bucketer (cached by hash; a miss —
+            # impossible while the in-process host lives, but cheap to
+            # handle — asks the client to resend with the layout). Pulls
+            # stay f32 (reference: 2-bit gc compresses worker->server
+            # traffic only).
+            spec_args, lhash, layout, slabs = msg[1:5]
+            ident = tuple(msg[5:7]) if len(msg) >= 7 else None
+            if self._replay(conn, ident):
+                return False
+            from .comm import (CompressionSpec, GradBucketer,
+                               decode_payload, payload_bytes_of)
+
+            spec = CompressionSpec(*spec_args)
+            with self.lock:
+                if layout is not None:
+                    self._layouts[lhash] = layout
+                layout = self._layouts.get(lhash)
+            if layout is None:
+                reply = ("err", f"unknown bucket layout {lhash}; "
+                         "resend with layout")
+                self._record(ident, reply)
+                _send_msg(conn, reply)
+                return False
+            flats, wire_b, raw_b = {}, 0, 0
+            for name, payload in slabs.items():
+                wire_b += payload_bytes_of(payload)
+                flats[name] = decode_payload(spec, payload)
+                raw_b += flats[name].nbytes
+            kvs = GradBucketer.from_layout(layout).unpack(flats)
+            reply = ("ok",)
+            with self.lock:
+                # counters join the other server stats under the lock
+                # (concurrent worker connections would lose increments)
+                self.wire_bytes_received += wire_b
+                self.raw_bytes_received += raw_b
+                missing = [k for k in kvs if k not in self.store]
+                if missing:
+                    reply = ("err", f"keys not initialized: {missing}")
+                else:
+                    self.update_count += 1
+                    for k, value in kvs.items():
+                        if self.updater is not None:
+                            self.updater(k, np.asarray(value, np.float32),
+                                         self.store[k])
+                        else:
+                            self.store[k] = np.array(value, np.float32)
+                    if op == "push_pull_enc":
+                        reply = ("ok", {k: self.store[k].copy()
+                                        for k in kvs})
+            self._record(ident, reply)
+            _send_msg(conn, reply)
         elif op in ("push_many", "push_pull"):
             kvs = msg[1]  # dict key -> np array: ONE round trip per batch
             ident = tuple(msg[2:4]) if len(msg) >= 4 else None
@@ -347,7 +416,10 @@ class _AsyncServer:
             _send_msg(conn, ("ok", values))
         elif op == "stats":
             with self.lock:
-                _send_msg(conn, ("ok", {"update_count": self.update_count}))
+                _send_msg(conn, ("ok", {
+                    "update_count": self.update_count,
+                    "wire_bytes_received": self.wire_bytes_received,
+                    "raw_bytes_received": self.raw_bytes_received}))
         elif op == "set_optimizer":
             _, blob = msg
             from .optimizer import get_updater
@@ -403,6 +475,9 @@ class AsyncKVStore(KVStore):
         self._rpc_timeout = float(
             os.environ.get("MXNET_TPU_RPC_TIMEOUT", "30"))
         self._retry_policy = None  # lazy: rank-seeded jitter
+        self._codec = None         # HostCodec for compressed pushes
+        self._bucketer = None      # (key tuple, bucketer, layout, hash)
+        self._layouts_sent: set = set()  # layout hashes the server holds
 
     def _server_addr(self):
         coord = os.environ.get("MXTPU_COORDINATOR")
@@ -526,23 +601,105 @@ class AsyncKVStore(KVStore):
             for o in outs:
                 NDArray(value).copyto(o)
 
-    def push_many(self, kvs: dict):
+    def set_gradient_compression(self, compression):
+        """Arm quantized+bucketed batch pushes (reference:
+        kvstore.set_gradient_compression). Grad dicts from push_many /
+        push_pull are fused into ~4 MB slabs, encoded (bf16/int8/twobit,
+        lossy modes with client-side error feedback), and decoded on the
+        parameter host before the updater runs; pulls stay f32. Per-key
+        ``push`` is the legacy API and stays uncompressed."""
+        from .comm import CompressionSpec, HostCodec
+
+        spec = CompressionSpec.resolve(compression)
+        self._compression = spec
+        self._codec = HostCodec(spec) if spec is not None else None
+        self._bucketer = None
+        self._layouts_sent: set = set()
+        self._call("set_compression",
+                   None if spec is None
+                   else (spec.mode, spec.threshold, spec.chunk))
+        return spec
+
+    def _encode_slabs(self, kvs: dict):
+        import hashlib
+        import pickle as _pickle
+
+        from .comm import GradBucketer, HostCodec
+
+        sig = tuple(sorted(kvs))
+        if self._bucketer is None or self._bucketer[0] != sig:
+            bucketer = GradBucketer(
+                [(k, tuple(np.asarray(kvs[k]).shape)) for k in sorted(kvs)])
+            layout = bucketer.layout()
+            lhash = hashlib.sha1(_pickle.dumps(layout)).hexdigest()[:16]
+            self._bucketer = (sig, bucketer, layout, lhash)
+            # a new layout orphans the error-feedback ledger: residuals
+            # compensate the slab they were computed against, and the
+            # reused bucket names would silently cross-inject them
+            self._codec = HostCodec(self._compression)
+        _, bucketer, layout, lhash = self._bucketer
+        flats = bucketer.pack({k: np.asarray(v, np.float32)
+                               for k, v in kvs.items()})
+        slabs = {name: self._codec.encode(name, flat)
+                 for name, flat in flats.items()}
+        return lhash, layout, slabs
+
+    def _call_enc(self, op, kvs):
+        """One compressed batch push. The (static, potentially large) key
+        layout ships once per bucketer — later pushes send only its hash;
+        a server-side cache miss answers "unknown bucket layout" and the
+        SAME slabs are resent with the layout attached (no re-encode: the
+        error-feedback residual already advanced)."""
+        spec = self._compression
+        spec_args = (spec.mode, spec.threshold, spec.chunk)
+        lhash, layout, slabs = self._encode_slabs(kvs)
+        send_layout = layout if lhash not in self._layouts_sent else None
+        try:
+            out = self._call(op, spec_args, lhash, send_layout, slabs,
+                             mutating=True)
+        except MXNetError as e:
+            if "unknown bucket layout" not in str(e) or send_layout is not None:
+                raise
+            self._layouts_sent.discard(lhash)
+            out = self._call(op, spec_args, lhash, layout, slabs,
+                             mutating=True)
+        self._layouts_sent.add(lhash)
+        return out
+
+    def push_many(self, kvs: dict, priority=0):
         """Push {key: numpy grad} in ONE round trip (the per-batch trainer
         path: serialized per-key round trips would dominate step time)."""
+        del priority
+        if self._codec is not None:
+            self._call_enc("push_many_enc", kvs)
+            return
         self._call("push_many",
                    {k: np.asarray(v, np.float32) for k, v in kvs.items()},
                    mutating=True)
 
-    def pull_many(self, keys) -> dict:
+    def pull_many(self, keys, priority=0) -> dict:
         """Pull current values for ``keys`` in one round trip."""
+        del priority
         return self._call("pull_many", list(keys))
 
-    def push_pull(self, kvs: dict) -> dict:
+    def push_pull(self, kvs: dict, priority=0) -> dict:
         """Apply grads and return the updated weights in ONE round trip —
-        the trainer's whole per-batch parameter-host sync."""
+        the trainer's whole per-batch parameter-host sync. With
+        compression armed the grads cross the socket quantized+bucketed."""
+        del priority
+        if self._codec is not None:
+            return self._call_enc("push_pull_enc", kvs)
         return self._call("push_pull",
                           {k: np.asarray(v, np.float32)
                            for k, v in kvs.items()}, mutating=True)
+
+    def compression_stats(self) -> dict:
+        """Client-side wire accounting for the compressed push path."""
+        if self._codec is None:
+            return {"bytes_raw": 0, "bytes_encoded": 0, "ratio": 1.0}
+        return {"bytes_raw": self._codec.bytes_raw,
+                "bytes_encoded": self._codec.bytes_encoded,
+                "ratio": self._codec.ratio}
 
     def set_updater(self, updater):
         raise MXNetError(

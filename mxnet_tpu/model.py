@@ -479,7 +479,7 @@ class FeedForward(BASE_ESTIMATOR):
 
     def _get_train_step(self, bucket_key, data_names, label_names, optimizer,
                         mesh, metric=None, apply_update=True, guard_cfg=None,
-                        pad_policy=None):
+                        pad_policy=None, compression=None):
         """The fused train step for one program configuration, built once
         and cached on the instance (reference analog: GraphExecutor's
         cached engine ops, one per shape). precompile() populates the same
@@ -489,6 +489,7 @@ class FeedForward(BASE_ESTIMATOR):
                else metric.device_key(), apply_update,
                None if guard_cfg is None else repr(vars(guard_cfg)),
                None if pad_policy is None else pad_policy.key(),
+               None if compression is None else compression.key(),
                str(self.compute_dtype))
         if key not in self._train_fns:
             warmed = sum(getattr(fn, "_tracked", None) is not None
@@ -509,12 +510,13 @@ class FeedForward(BASE_ESTIMATOR):
                 symbol=self._symbol_for_bucket(bucket_key),
                 metric_update=None if metric is None else metric.device_update,
                 apply_update=apply_update, guard_cfg=guard_cfg,
-                pad_policy=pad_policy, label=label)
+                pad_policy=pad_policy, compression=compression, label=label)
         return self._train_fns[key]
 
     def _build_train_step(self, data_names, label_names, optimizer, mesh,
                           symbol=None, metric_update=None, apply_update=True,
-                          guard_cfg=None, pad_policy=None, label=None):
+                          guard_cfg=None, pad_policy=None, compression=None,
+                          label=None):
         """Compile the fused train step.
 
         With ``guard_cfg`` (resilience.GuardConfig) the program additionally
@@ -531,19 +533,38 @@ class FeedForward(BASE_ESTIMATOR):
         ``fwd_masked``) and the fused metric skips them, so a tail batch
         padded up to the training shape is metric- and loss-correct while
         reusing the ONE compiled program (no fresh shape, no recompile).
+
+        With ``compression`` (a comm.CompressionSpec; mesh path only) the
+        step is built as a shard_map over the 'dp' axis so the gradient
+        sync is the EXPLICIT quantized allreduce from comm/allreduce.py
+        instead of the partitioner's fp32 psum. Lossy modes additionally
+        thread a donated comm-state pytree (the error-feedback residual,
+        row-sharded so each device carries its own quantization error)
+        through the carry exactly like the guard state; metric deltas and
+        aux updates are psum/pmean'd so the fused device metric and
+        BatchNorm statistics stay global. Donation and the zero-recompile
+        steady-state invariant are preserved (tests/test_comm.py).
         """
         graph_fn = _build_graph_fn(symbol if symbol is not None else self.symbol,
                                    is_train=True)
         compute_dtype = self.compute_dtype
+        comm_spec = compression if mesh is not None else None
+        in_shard = comm_spec is not None  # compute body runs inside shard_map
+        axis_size = int(mesh.shape["dp"]) if mesh is not None else 1
+        has_cstate = in_shard and comm_spec.error_feedback
 
         def compute(params, opt_state, aux, batch, rng, lr, mstate, gstate,
-                    valid):
+                    valid, cstate=None):
+            from . import comm as comm_mod
+
             scale = gstate["scale"] if guard_cfg is not None else None
             mask = None
             if valid is not None:
                 rows_of = label_names[0] if label_names else data_names[0]
                 n_rows = batch[rows_of].shape[0]
-                mask = (jnp.arange(n_rows) < valid).astype(jnp.float32)
+                row0 = jax.lax.axis_index("dp") * n_rows if in_shard else 0
+                mask = ((row0 + jnp.arange(n_rows)) < valid).astype(
+                    jnp.float32)
 
             def loss_fn(p):
                 if compute_dtype is not None:
@@ -566,6 +587,24 @@ class FeedForward(BASE_ESTIMATOR):
             if scale is not None:
                 inv = 1.0 / scale
                 grads = {k: g * inv.astype(g.dtype) for k, g in grads.items()}
+            new_cstate = cstate
+            if in_shard:
+                # explicit gradient sync (sum semantics, matching the
+                # partitioner-inserted psum; the optimizer's rescale_grad
+                # turns the sum into the mean)
+                if has_cstate:
+                    grads, resid = comm_mod.error_feedback_allreduce(
+                        grads, cstate["resid"], comm_spec, axis_name="dp",
+                        axis_size=axis_size, average=False)
+                    new_cstate = {"resid": resid}
+                else:
+                    grads = comm_mod.compressed_allreduce(
+                        grads, comm_spec, axis_name="dp",
+                        axis_size=axis_size, average=False)
+                loss = jax.lax.psum(loss, "dp")
+                new_aux = jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, "dp")
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, new_aux)
             finite = None
             if guard_cfg is not None and guard_cfg.skip_nonfinite:
                 # scaled loss + unscaled grads: overflow in either shows up
@@ -594,11 +633,23 @@ class FeedForward(BASE_ESTIMATOR):
                 # reads them, so XLA needn't materialize them every step
                 labels = [batch[n] for n in label_names]
                 outs_f32 = [o.astype(jnp.float32) for o in outs]
+                base = mstate
+                if in_shard:
+                    # device metrics are additive (sum, count) accumulators:
+                    # fold each shard's DELTA from a zero state, psum it,
+                    # and add — updating from mstate per shard would count
+                    # the replicated base axis_size times
+                    base = jax.tree_util.tree_map(jnp.zeros_like, mstate)
                 if mask is not None:
-                    new_mstate = metric_update(mstate, labels, outs_f32,
+                    new_mstate = metric_update(base, labels, outs_f32,
                                                valid=mask)
                 else:
-                    new_mstate = metric_update(mstate, labels, outs_f32)
+                    new_mstate = metric_update(base, labels, outs_f32)
+                if in_shard:
+                    delta = jax.tree_util.tree_map(
+                        lambda d: jax.lax.psum(d, "dp"), new_mstate)
+                    new_mstate = jax.tree_util.tree_map(jnp.add, mstate,
+                                                        delta)
                 if finite is not None:
                     new_mstate = guards_mod.guard_select(
                         finite, new_mstate, mstate)
@@ -608,11 +659,17 @@ class FeedForward(BASE_ESTIMATOR):
                 gstate = guards_mod.update_guard_state(
                     guard_cfg, gstate,
                     finite if finite is not None else jnp.bool_(True))
-            return new_params, new_opt_state, new_aux, outs, mstate, gstate
+            return (new_params, new_opt_state, new_aux, outs, mstate, gstate,
+                    new_cstate)
 
-        # signature tail: [gstate][valid] — donated indices stay fixed for
-        # the existing configurations; ``valid`` (a scalar) is never donated
+        # signature tail: [gstate][cstate][valid] — donated indices stay
+        # fixed for the existing configurations; ``valid`` (a scalar) is
+        # never donated
         padded = pad_policy is not None
+        if in_shard:
+            return self._finish_sharded_step(
+                compute, mesh, comm_spec, axis_size, guard_cfg, has_cstate,
+                padded, label)
         if guard_cfg is None:
             if padded:
                 def step(params, opt_state, aux, batch, rng, lr, mstate,
@@ -630,12 +687,12 @@ class FeedForward(BASE_ESTIMATOR):
                 def step(params, opt_state, aux, batch, rng, lr, mstate,
                          gstate, valid):
                     return compute(params, opt_state, aux, batch, rng, lr,
-                                   mstate, gstate, valid)
+                                   mstate, gstate, valid)[:6]
             else:
                 def step(params, opt_state, aux, batch, rng, lr, mstate,
                          gstate):
                     return compute(params, opt_state, aux, batch, rng, lr,
-                                   mstate, gstate, None)
+                                   mstate, gstate, None)[:6]
 
             donate = (0, 1, 2, 6, 7)
 
@@ -702,6 +759,90 @@ class FeedForward(BASE_ESTIMATOR):
         run._tracked = jitted
         return run
 
+    def _finish_sharded_step(self, compute, mesh, comm_spec, axis_size,
+                             guard_cfg, has_cstate, padded, label):
+        """Assemble the compressed-comm train step: ``jit(shard_map(...))``
+        over the dp axis (see _build_train_step's compression note).
+
+        In/out specs mirror the signature tail — params/opt/aux/metric/
+        guard state replicated, batch and forward outputs row-sharded, the
+        error-feedback comm state row-sharded so each device keeps its own
+        residual. Donation matches the SPMD path; the program's exact wire
+        plan registers with the comm registry at first dispatch and every
+        call counts one sync step (``comm.comm_stats()``)."""
+        from . import comm as comm_mod
+        from .compat import shard_map as _shard_map
+
+        has_g = guard_cfg is not None
+
+        def step(params, opt_state, aux, batch, rng, lr, mstate, *rest):
+            i = 0
+            gstate = cstate = valid = None
+            if has_g:
+                gstate = rest[i]
+                i += 1
+            if has_cstate:
+                cstate = rest[i]
+                i += 1
+            if padded:
+                valid = rest[i]
+            res = compute(params, opt_state, aux, batch, rng, lr, mstate,
+                          gstate, valid, cstate)
+            out = res[:5]
+            if has_g:
+                out += (res[5],)
+            if has_cstate:
+                out += (res[6],)
+            return out
+
+        tail_in = (P(),) * has_g + (P("dp"),) * has_cstate + (P(),) * padded
+        in_specs = (P(), P(), P(), P("dp"), P(), P(), P()) + tail_in
+        out_specs = (P(), P(), P(), P("dp"), P()) \
+            + (P(),) * has_g + (P("dp"),) * has_cstate
+        sharded = _shard_map(step, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+        donate = (0, 1, 2, 6) + tuple(7 + j
+                                      for j in range(has_g + has_cstate))
+        jitted = compile_mod.tracked_jit(sharded, label=label,
+                                         donate_argnums=donate)
+        repl = NamedSharding(mesh, P())
+        batch_sh = NamedSharding(mesh, P("dp"))
+        csh = NamedSharding(mesh, P("dp"))
+        reg = comm_mod.registry()
+        plan_state = {"registered": False}
+
+        def run(params, opt_state, aux, batch, rng, lr, mstate, *rest):
+            if not plan_state["registered"]:
+                reg.register_plan(label, comm_mod.allreduce_plan(
+                    comm_mod.flat_size(params), axis_size, comm_spec))
+                plan_state["registered"] = True
+            reg.record_step(label)
+            batch = {k: _place(v, batch_sh if np.ndim(v) else repl)
+                     for k, v in batch.items()}
+            place_repl = lambda t: (jax.tree_util.tree_map(  # noqa: E731
+                lambda v: _place(v, repl), t) if _needs_place(t, mesh) else t)
+            params = place_repl(params)
+            opt_state = place_repl(opt_state)
+            aux = place_repl(aux)
+            mstate = place_repl(mstate)
+            placed, i = [], 0
+            if has_g:
+                placed.append(place_repl(rest[i]))
+                i += 1
+            if has_cstate:
+                c = rest[i]
+                i += 1
+                if _needs_place(c, mesh):
+                    c = jax.tree_util.tree_map(lambda v: _place(v, csh), c)
+                placed.append(c)
+            if padded:
+                placed.append(_place(jnp.asarray(rest[i]), repl))
+            return jitted(params, opt_state, aux, batch, rng,
+                          jnp.float32(lr), mstate, *placed)
+
+        run._tracked = jitted
+        return run
+
     def _async_pull_params(self, kv, param_names):
         """Pull current weights from the dist_async parameter host into
         self.arg_params (one round trip for all keys)."""
@@ -730,7 +871,8 @@ class FeedForward(BASE_ESTIMATOR):
     def fit(self, X, y=None, eval_data=None, eval_metric="accuracy",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, batch_size=128,
-            sharded_checkpoint_dir=None, guards=None, pad_policy=None):
+            sharded_checkpoint_dir=None, guards=None, pad_policy=None,
+            compression=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -759,10 +901,24 @@ class FeedForward(BASE_ESTIMATOR):
         padded up to the training shape and masked (loss- and
         metric-correct: padded rows inject no gradient and are excluded
         from the metric) instead of compiling a second program for the odd
-        shape (doc/developer-guide/compile_cache.md)."""
+        shape (doc/developer-guide/compile_cache.md).
+
+        ``compression``: gradient-sync wire control — None (default; env
+        gate MXNET_TPU_GRAD_COMPRESSION), True/'bf16'/'int8'/'twobit', a
+        reference-style dict ``{'type': '2bit', 'threshold': 0.5}``, or a
+        comm.CompressionSpec. On a multi-device mesh the fused step syncs
+        one quantized bucket instead of the fp32 psum (int8/twobit thread
+        an error-feedback residual through the step carry for convergence
+        parity); with kvstore='dist_async' the spec is forwarded to
+        ``kv.set_gradient_compression`` so pushes cross the socket
+        quantized. Wire accounting: ``comm.comm_stats()`` and the
+        per-epoch ``Comm:`` log line (doc/developer-guide/comm.md)."""
         del work_load_list
         guard_cfg = guards_mod.GuardConfig.resolve(guards)
         pad_policy = compile_mod.PadPolicy.resolve(pad_policy)
+        from . import comm as comm_mod
+
+        comm_spec = comm_mod.CompressionSpec.resolve(compression)
         resume_opt_leaves, resume_num_update = None, 0
         resume_scale = None
         if sharded_checkpoint_dir is not None:
@@ -825,6 +981,20 @@ class FeedForward(BASE_ESTIMATOR):
                                             num_workers)
         self._optimizer_obj = optimizer
 
+        async_comm_spec = None
+        if comm_spec is not None and async_kv:
+            # host-transport compression: grads cross the parameter-host
+            # socket quantized+bucketed (kvstore_async.py); no in-jit comm
+            if hasattr(kv, "set_gradient_compression"):
+                kv.set_gradient_compression(comm_spec)
+                async_comm_spec = comm_spec
+            comm_spec = None
+        elif comm_spec is not None and mesh is None:
+            logger.info("compression=%s ignored: single-device training "
+                        "moves no gradient bytes over a wire",
+                        comm_spec.mode)
+            comm_spec = None
+
         if async_kv:
             if sharded_checkpoint_dir is not None and num_workers > 1:
                 # single-worker dist_async (one replica, one writer) is
@@ -863,6 +1033,16 @@ class FeedForward(BASE_ESTIMATOR):
         # programs live in self._train_fns so precompile() warms the exact
         # entries this loop dispatches; this is just the per-epoch memo.
         train_steps = {}
+
+        # error-feedback comm state: per-device quantization residuals,
+        # row-sharded so each device carries only its own error (threaded
+        # and donated through the step exactly like the guard state)
+        cstate = None
+        if comm_spec is not None and comm_spec.error_feedback:
+            resid = optimizer.init_comm_residual(
+                params, comm_spec, int(mesh.shape["dp"]))
+            cstate = {"resid": jax.device_put(
+                resid, NamedSharding(mesh, P("dp")))}
 
         # -- resilience wiring (all of it no-op when guards are off and no
         # checkpoint dir is given; the unguarded hot path is unchanged) ----
@@ -987,6 +1167,11 @@ class FeedForward(BASE_ESTIMATOR):
           for epoch in range(self.begin_epoch, self.num_epoch or 1):
             tic = time.time()
             compile_snap = compile_mod.registry().snapshot()
+            comm_snap = comm_mod.registry().snapshot() \
+                if comm_spec is not None else None
+            host_comm_snap = kv.compression_stats() \
+                if async_comm_spec is not None and \
+                hasattr(kv, "compression_stats") else None
             eval_metric.reset()
             maccum = self._DeviceMetricAccum(eval_metric)
             nbatch = 0
@@ -1013,7 +1198,8 @@ class FeedForward(BASE_ESTIMATOR):
                             bkey, b_dnames, b_lnames, optimizer, mesh,
                             metric=eval_metric if use_device_metric else None,
                             apply_update=not async_kv,
-                            guard_cfg=guard_cfg, pad_policy=pad_policy)
+                            guard_cfg=guard_cfg, pad_policy=pad_policy,
+                            compression=comm_spec)
                     train_step = train_steps[bkey]
                     pad_tail = ()
                     if pad_policy is not None:
@@ -1021,10 +1207,13 @@ class FeedForward(BASE_ESTIMATOR):
                     rng = random_mod.next_key()
                     lr = optimizer._get_lr()
                     optimizer.num_update = num_update
+                    # state tail mirrors the step signature:
+                    # [gstate][cstate][valid]
                     if guard_cfg is None:
-                        params, opt_state, aux, outs, maccum.state = \
-                            train_step(params, opt_state, aux, batch_arrays,
-                                       rng, lr, maccum.state, *pad_tail)
+                        tail = () if cstate is None else (cstate,)
+                        res = train_step(params, opt_state, aux,
+                                         batch_arrays, rng, lr,
+                                         maccum.state, *tail, *pad_tail)
                     else:
                         batch_arrays = self._chaos_step_sites(
                             batch_arrays, b_dnames, watchdog)
@@ -1036,10 +1225,11 @@ class FeedForward(BASE_ESTIMATOR):
                                 chaos_mod.maybe_raise(
                                     "step.raise",
                                     chaos_mod.TransientStepError)
-                                (params, opt_state, aux, outs, maccum.state,
-                                 gstate) = train_step(
+                                tail = (gstate,) if cstate is None \
+                                    else (gstate, cstate)
+                                res = train_step(
                                     params, opt_state, aux, batch_arrays,
-                                    rng, lr, maccum.state, gstate, *pad_tail)
+                                    rng, lr, maccum.state, *tail, *pad_tail)
                                 break
                             except chaos_mod.TransientStepError:
                                 if retries <= 0:
@@ -1048,6 +1238,13 @@ class FeedForward(BASE_ESTIMATOR):
                                 self.guard_stats["step_retries"] += 1
                         if watchdog is not None:
                             watchdog.beat()
+                    params, opt_state, aux, outs, maccum.state = res[:5]
+                    idx = 5
+                    if guard_cfg is not None:
+                        gstate = res[idx]
+                        idx += 1
+                    if cstate is not None:
+                        cstate = res[idx]
                     step_finite = True
                     if guard_cfg is not None and (async_kv
                                                   or not use_device_metric):
@@ -1125,6 +1322,28 @@ class FeedForward(BASE_ESTIMATOR):
                     - compile_snap["persistent_cache_hits"],
                     cdiff["persistent_cache_saved_seconds"]
                     - compile_snap["persistent_cache_saved_seconds"])
+            if comm_snap is not None:
+                cdelta = comm_mod.registry().snapshot()
+                steps_d = cdelta["steps"] - comm_snap["steps"]
+                if steps_d:
+                    wire_d = cdelta["wire_bytes"] - comm_snap["wire_bytes"]
+                    fp32_d = (cdelta["fp32_wire_bytes"]
+                              - comm_snap["fp32_wire_bytes"])
+                    logger.info(
+                        "Epoch[%d] Comm: %d sync steps, %.2f MB on the wire "
+                        "(%s; fp32 would be %.2f MB, %.1fx)", epoch,
+                        steps_d, wire_d / 1e6, comm_spec.mode, fp32_d / 1e6,
+                        fp32_d / wire_d if wire_d else float("inf"))
+            if host_comm_snap is not None:
+                hs = kv.compression_stats()
+                sent_d = hs["bytes_encoded"] - host_comm_snap["bytes_encoded"]
+                raw_d = hs["bytes_raw"] - host_comm_snap["bytes_raw"]
+                if sent_d:
+                    logger.info(
+                        "Epoch[%d] Comm: %.2f MB pushed to the parameter "
+                        "host (%s; fp32 would be %.2f MB, %.1fx)", epoch,
+                        sent_d / 1e6, async_comm_spec.mode, raw_d / 1e6,
+                        raw_d / sent_d)
             if guard_cfg is not None:
                 self.guard_stats["skipped_steps"] = int(np.asarray(
                     _host_local(gstate["skipped"])))
@@ -1174,7 +1393,8 @@ class FeedForward(BASE_ESTIMATOR):
     # -- AOT warmup -----------------------------------------------------------
     def precompile(self, data_shapes=None, label_shapes=None, *, data=None,
                    eval_metric="accuracy", kvstore="local", guards=None,
-                   pad_policy=None, batch_end_callback=None, parallel=True):
+                   pad_policy=None, compression=None, batch_end_callback=None,
+                   parallel=True):
         """AOT warmup: compile every fused train program ``fit`` would need
         BEFORE training, via ``.lower().compile()`` — so step 1 of each
         shape dispatches a ready executable instead of stalling on XLA
@@ -1225,6 +1445,9 @@ class FeedForward(BASE_ESTIMATOR):
 
         guard_cfg = guards_mod.GuardConfig.resolve(guards)
         pad_policy = compile_mod.PadPolicy.resolve(pad_policy)
+        from . import comm as comm_mod
+
+        comm_spec = comm_mod.CompressionSpec.resolve(compression)
         metric = metric_mod.create(eval_metric)
         # same fusion decision as fit(): a batch callback needs per-batch
         # host metric values, so the metric stays out of the step program
@@ -1244,6 +1467,8 @@ class FeedForward(BASE_ESTIMATOR):
         first_shape = _split(next(iter(programs[0][1].values())))[0]
         batch_size = int(first_shape[0])
         mesh = self._make_mesh(dist=False)
+        if mesh is None:
+            comm_spec = None  # matches fit(): no mesh, no wire, no comm
         optimizer = self._resolve_optimizer(param_names, batch_size)
 
         def _sds(shape, dtype, sharded=False):
@@ -1274,7 +1499,7 @@ class FeedForward(BASE_ESTIMATOR):
                 bkey, data_names_p, label_names_p, optimizer, mesh,
                 metric=metric if use_device_metric else None,
                 apply_update=True, guard_cfg=guard_cfg,
-                pad_policy=pad_policy)
+                pad_policy=pad_policy, compression=comm_spec)
             batch_s = {}
             for name, spec in {**d, **l}.items():
                 shape, dtype = _split(spec)
@@ -1283,6 +1508,13 @@ class FeedForward(BASE_ESTIMATOR):
                     mstate_s)
             if guard_cfg is not None:
                 args += (guards_mod.init_guard_state(guard_cfg),)
+            if comm_spec is not None and comm_spec.error_feedback:
+                ndev = int(mesh.shape["dp"])
+                Lp = comm_mod.padded_flat_size(
+                    sum(int(np.prod(self.arg_params[k].shape))
+                        for k in param_names), comm_spec, ndev)
+                args += ({"resid": _sds((ndev, Lp), np.dtype(np.float32),
+                                        sharded=True)},)
             if pad_policy is not None:
                 args += (_sds((), np.dtype(np.int32)),)
             jobs.append((step._tracked, args))

@@ -40,15 +40,23 @@ class Monitor:
     compile-seconds since the last collection, from the program registry —
     utils/compile), so shape drift shows up next to the layer stats it
     usually corrupts. A RecompileTracker given ``monitor=`` pushes its
-    ``recompile/<program>`` events into the same queue."""
+    ``recompile/<program>`` events into the same queue.
+
+    ``track_comm=True`` does the same for the gradient-communication
+    registry (mxnet_tpu.comm): ``comm/steps``, ``comm/wire_bytes``, and
+    ``comm/fp32_wire_bytes`` deltas per collection window, so a comm
+    regression (compression silently off, extra sync steps) shows up in
+    the same stat stream as the layer activations."""
 
     def __init__(self, interval, stat_func=None, pattern=".*",
-                 track_nonfinite=False, track_compiles=False):
+                 track_nonfinite=False, track_compiles=False,
+                 track_comm=False):
         self.interval = interval
         self.stat_func = stat_func or (lambda x: np.abs(x).mean())
         self.pattern = re.compile(pattern)
         self.track_nonfinite = track_nonfinite
         self.track_compiles = track_compiles
+        self.track_comm = track_comm
         self.step = 0
         self.activated = False
         self.queue = []
@@ -60,6 +68,11 @@ class Monitor:
             from .utils import compile as compile_mod
 
             self._compile_snap = compile_mod.compile_stats()
+        self._comm_snap = None
+        if track_comm:
+            from . import comm as comm_mod
+
+            self._comm_snap = comm_mod.registry().snapshot()
         # RecompileTracker(monitor=...) drops events here; drained into the
         # stat rows at the next toc()/collect_compiles() — appending to
         # .queue directly would be lost when toc() rebinds it
@@ -104,7 +117,28 @@ class Monitor:
             res.extend(self.collect_compiles())
         else:
             res.extend(self._drain_recompiles())
+        if self.track_comm:
+            res.extend(self.collect_comm())
         self.queue = res
+        return res
+
+    def collect_comm(self):
+        """Comm-registry deltas since the last collection, as stat rows:
+        ``comm/steps``, ``comm/wire_bytes``, ``comm/fp32_wire_bytes``
+        (what the same sync steps would have cost uncompressed)."""
+        from . import comm as comm_mod
+
+        stats = comm_mod.registry().snapshot()
+        prev = self._comm_snap or {"steps": 0, "wire_bytes": 0.0,
+                                   "fp32_wire_bytes": 0.0}
+        res = [
+            (self.step, "comm/steps", stats["steps"] - prev["steps"]),
+            (self.step, "comm/wire_bytes",
+             stats["wire_bytes"] - prev["wire_bytes"]),
+            (self.step, "comm/fp32_wire_bytes",
+             stats["fp32_wire_bytes"] - prev["fp32_wire_bytes"]),
+        ]
+        self._comm_snap = stats
         return res
 
     def _drain_recompiles(self):

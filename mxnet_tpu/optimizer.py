@@ -68,6 +68,19 @@ class Optimizer:
     def init_state_tree(self, params: dict):
         return {k: self.tree_state(v) for k, v in params.items()}
 
+    def init_comm_residual(self, params: dict, compression, num_devices):
+        """Error-feedback residual for compressed gradient sync (comm/
+        allreduce.py), or None when the mode needs no feedback.
+
+        Lives on the optimizer because — like momentum — the residual is
+        per-parameter training state accumulated in the optimizer's
+        gradient units (pre-``rescale_grad`` sums): it must be (re)built
+        whenever the optimizer or parameter set changes, and a checkpoint
+        that restores one without the other restarts the error ledger."""
+        from .comm import init_error_feedback
+
+        return init_error_feedback(params, compression, num_devices)
+
     def tree_state(self, w):
         return None
 

@@ -26,16 +26,21 @@ def replicate_params(params, mesh):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), params)
 
 
-def allreduce_grads(grads, axis_name="dp", average=True):
-    """psum (optionally mean) over the data axis — call inside shard_map.
+def allreduce_grads(grads, axis_name="dp", average=True, compression=None,
+                    axis_size=None):
+    """Gradient allreduce over the data axis — call inside shard_map.
 
     ≙ the reference's ReduceSumCPU + dist_sync server accumulate
-    (kvstore_local.h:180-235, kvstore_dist_server.h:164-193)."""
-    n = jax.lax.psum(1, axis_name)
-    summed = jax.tree_util.tree_map(lambda g: jax.lax.psum(g, axis_name), grads)
-    if average:
-        return jax.tree_util.tree_map(lambda g: g / n, summed)
-    return summed
+    (kvstore_local.h:180-235, kvstore_dist_server.h:164-193). Routed
+    through :mod:`mxnet_tpu.comm` — with ``compression=None`` this is the
+    exact per-leaf psum it always was; with a CompressionSpec (or mode
+    name) the tree fuses into one flat bucket and syncs quantized
+    (``axis_size`` — the mesh's data-axis extent — is then required; see
+    comm/allreduce.py for the wire decomposition)."""
+    from ..comm import compressed_allreduce
+
+    return compressed_allreduce(grads, compression, axis_name=axis_name,
+                                axis_size=axis_size, average=average)
 
 
 def grad_accum(loss_fn, params, batch, n_micro):
@@ -70,7 +75,7 @@ def grad_accum(loss_fn, params, batch, n_micro):
 
 
 def make_data_parallel_step(loss_fn, update_fn, mesh, axis="dp",
-                            donate=True, n_micro=1):
+                            donate=True, n_micro=1, compression=None):
     """Build a jitted data-parallel train step over ``mesh``.
 
     ``loss_fn(params, batch) -> scalar mean loss``;
@@ -82,22 +87,82 @@ def make_data_parallel_step(loss_fn, update_fn, mesh, axis="dp",
 
     Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``;
     feed batches placed with :func:`shard_batch`.
-    """
-    rep = NamedSharding(mesh, P())
 
-    def step(params, opt_state, batch):
+    ``compression`` (comm.CompressionSpec / mode name / None) swaps the
+    partitioner-inserted fp32 psum for the explicit quantized allreduce
+    (comm/allreduce.py): the step becomes a shard_map over ``axis`` whose
+    body syncs one fused low-precision bucket. Lossy modes (int8/twobit)
+    thread an error-feedback residual, so the step signature grows to
+    ``step(params, opt_state, batch, comm_state) -> (params, opt_state,
+    loss, comm_state)`` — seed it with
+    ``comm.init_error_feedback(params, spec, mesh.shape[axis])`` placed
+    ``P(axis)`` on the mesh.
+    """
+    from ..comm import (CompressionSpec, compressed_allreduce,
+                        error_feedback_allreduce)
+
+    rep = NamedSharding(mesh, P())
+    spec = CompressionSpec.resolve(compression)
+
+    if spec is None:
+        def step(params, opt_state, batch):
+            if n_micro > 1:
+                loss, grads = grad_accum(loss_fn, params, batch, n_micro)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            params, opt_state = update_fn(params, opt_state, grads)
+            return params, opt_state, loss
+
+        return jax.jit(
+            step,
+            out_shardings=(rep, rep, rep),
+            donate_argnums=(0, 1) if donate else (),
+        )
+
+    from ..compat import shard_map as _shard_map
+
+    ndev = int(mesh.shape[axis])
+    has_ef = spec.error_feedback
+
+    def shard_body(params, batch, *comm_state):
         if n_micro > 1:
             loss, grads = grad_accum(loss_fn, params, batch, n_micro)
         else:
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        # per-shard loss_fn means over local rows: the global mean gradient
+        # is the average of shard gradients
+        loss = jax.lax.pmean(loss, axis)
+        if has_ef:
+            grads, resid = error_feedback_allreduce(
+                grads, comm_state[0], spec, axis_name=axis, axis_size=ndev,
+                average=True)
+            return loss, grads, resid
+        grads = compressed_allreduce(grads, spec, axis_name=axis,
+                                     axis_size=ndev, average=True)
+        return loss, grads
+
+    in_specs = (P(), P(axis)) + ((P(axis),) if has_ef else ())
+    out_specs = (P(), P()) + ((P(axis),) if has_ef else ())
+    sharded = _shard_map(shard_body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+    if has_ef:
+        def step(params, opt_state, batch, comm_state):
+            loss, grads, comm_state = sharded(params, batch, comm_state)
+            params, opt_state = update_fn(params, opt_state, grads)
+            return params, opt_state, loss, comm_state
+
+        csh = NamedSharding(mesh, P(axis))
+        return jax.jit(step, out_shardings=(rep, rep, rep, csh),
+                       donate_argnums=(0, 1, 3) if donate else ())
+
+    def step(params, opt_state, batch):
+        loss, grads = sharded(params, batch)
         params, opt_state = update_fn(params, opt_state, grads)
         return params, opt_state, loss
 
-    return jax.jit(
-        step,
-        out_shardings=(rep, rep, rep),
-        donate_argnums=(0, 1) if donate else (),
-    )
+    return jax.jit(step, out_shardings=(rep, rep, rep),
+                   donate_argnums=(0, 1) if donate else ())
 
 
 def host_local_batch_to_global(batch, mesh, axis="dp"):

@@ -271,7 +271,8 @@ class RetryingKVStore:
         self.stats["resyncs"] += 1
 
     # -- dist_async bulk surface (present only on AsyncKVStore) ----------------
-    def push_pull(self, kvs: dict) -> dict:
+    def push_pull(self, kvs: dict, priority=0) -> dict:
+        del priority  # uniform data-plane kwarg (see kvstore.py docstring)
         try:
             result = self._guarded(
                 "kvstore.push", lambda: self._inner.push_pull(kvs),
@@ -285,7 +286,8 @@ class RetryingKVStore:
             self._mirror_put(k, v)
         return result
 
-    def pull_many(self, keys) -> dict:
+    def pull_many(self, keys, priority=0) -> dict:
+        del priority
         try:
             result = self._guarded(
                 "kvstore.pull", lambda: self._inner.pull_many(keys),
@@ -298,7 +300,8 @@ class RetryingKVStore:
         self.stats["resyncs"] += 1
         return result
 
-    def push_many(self, kvs: dict):
+    def push_many(self, kvs: dict, priority=0):
+        del priority
         try:
             self._guarded("kvstore.push",
                           lambda: self._inner.push_many(kvs),

@@ -20,7 +20,8 @@ import time
 import jax
 
 __all__ = ["start_trace", "stop_trace", "profile_scope", "Timer",
-           "OpStat", "trace_op_stats", "profile_step", "compile_report"]
+           "OpStat", "trace_op_stats", "profile_step", "compile_report",
+           "comm_report"]
 
 
 def start_trace(log_dir: str):
@@ -124,6 +125,40 @@ def compile_report(stats: dict | None = None) -> str:
             f"  {c['compile_seconds']:8.2f}s  x{c['compiles']:<3d} "
             f"hits={c['hits']:<6d} misses={c['misses']:<3d} "
             f"programs={c.get('programs', 0):<3d} {name}")
+    return "\n".join(lines)
+
+
+def comm_report(stats: dict | None = None) -> str:
+    """Human-readable wire accounting: per-program comm plans, sync-step
+    counts, and cumulative wire bytes vs the fp32 baseline, from the
+    gradient-communication registry (mxnet_tpu.comm — the same counters
+    fit() logs per epoch as ``Comm:`` lines)."""
+    from .. import comm as comm_mod
+
+    stats = stats if stats is not None else comm_mod.comm_stats()
+    ratio = stats.get("ratio")
+    lines = [
+        f"sync_steps={stats['steps']} "
+        f"wire_mb={stats['wire_bytes'] / 1e6:.2f} "
+        f"fp32_mb={stats['fp32_wire_bytes'] / 1e6:.2f} "
+        + (f"ratio={ratio:.2f}x" if ratio else "ratio=n/a")
+        + (f" host_sent_mb={stats['host_bytes']['sent'] / 1e6:.2f}"
+           f" host_recv_mb={stats['host_bytes']['received'] / 1e6:.2f}"
+           if stats.get("host_bytes", {}).get("sent")
+           or stats.get("host_bytes", {}).get("received") else "")
+    ]
+    for name, p in sorted(stats.get("per_program", {}).items(),
+                          key=lambda kv: -kv[1]["total_wire_bytes"]):
+        lines.append(
+            f"  {p['mode']:>6s}  x{p['steps']:<6d} "
+            f"{p['wire_bytes'] / 1e3:9.2f} kB/step "
+            f"(fp32 {p['fp32_wire_bytes'] / 1e3:.2f} kB, "
+            f"{p['ratio']:.2f}x)  {name}")
+        for row in p.get("collectives", ()):
+            lines.append(
+                f"          {row['op']:<18s} x{row['count']:<3d} "
+                f"payload={row['payload_bytes'] / 1e3:.2f} kB "
+                f"wire={row['wire_bytes'] / 1e3:.2f} kB")
     return "\n".join(lines)
 
 

@@ -13,6 +13,33 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_bench_comm_smoke_json_contract():
+    """--comm-bench --smoke is the CI guard on the comm bench entry (tiny
+    shapes, CPU mesh, no file written): one JSON line with the contract
+    keys, all four modes measured, and the int8 plan ratio sane."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--comm-bench",
+         "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "modes"):
+        assert key in blob, blob
+    assert blob["value"] > 1.0  # int8 moves fewer bytes than fp32
+    assert set(blob["modes"]) == {"none", "bf16", "int8", "twobit"}
+    for mode, row in blob["modes"].items():
+        assert row["hlo_wire_bytes_per_step"] > 0, mode
+        assert row["step_ms"] > 0, mode
+    # int8 is integer-typed on the wire, so CPU HLO shows it faithfully:
+    # compiled reality must agree with the closed-form plan
+    assert blob["modes"]["int8"]["hlo_wire_bytes_per_step"] == pytest.approx(
+        blob["modes"]["int8"]["plan_wire_bytes_per_step"], rel=0.02)
+    assert blob["smoke"] is True  # smoke runs never write BENCH_COMM_*.json
+
+
 @pytest.mark.slow
 def test_bench_pipeline_mode_json_contract(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")

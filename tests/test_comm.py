@@ -1,0 +1,620 @@
+"""Gradient-communication subsystem tests (ISSUE 4 acceptance).
+
+Covers: compression kernels (roundtrip bounds, jax/numpy agreement,
+twobit packing), the in-jit compressed allreduce (correctness, error
+feedback), wire-plan arithmetic + HLO cross-check (THE acceptance
+criterion: int8 cuts wire bytes >= 3.5x vs fp32 on the 8-virtual-device
+mesh), FeedForward fit(compression=...) convergence parity + armed
+zero-recompile steady state, bucketing + host codec, the kvstore
+transports (group/dist/async), the uniform priority= kwarg, and the
+observability surfaces (comm_stats, Monitor, comm_report, jaxpr audit).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import comm
+from mxnet_tpu import kvstore
+from mxnet_tpu import parallel as par
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.compat import shard_map
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.utils import compile as cm
+
+
+def _mesh8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.array(devs[:8]), ("dp",))
+
+
+# -- CompressionSpec -----------------------------------------------------------
+
+def test_spec_resolve_and_env(monkeypatch):
+    assert comm.CompressionSpec.resolve(None) is None
+    assert comm.CompressionSpec.resolve(True).mode == "int8"
+    assert comm.CompressionSpec.resolve("twobit").mode == "twobit"
+    assert comm.CompressionSpec.resolve("2bit").mode == "twobit"  # MXNet name
+    assert comm.CompressionSpec.resolve("none") is None
+    spec = comm.CompressionSpec("int8", chunk=128)
+    assert comm.CompressionSpec.resolve(spec) is spec
+    d = comm.CompressionSpec.resolve({"type": "2bit", "threshold": 0.25})
+    assert d.mode == "twobit" and d.threshold == 0.25
+    monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESSION", "bf16")
+    assert comm.CompressionSpec.resolve(None).mode == "bf16"
+    monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESSION", "1")
+    assert comm.CompressionSpec.resolve(None).mode == "int8"
+    monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESSION", "0")
+    assert comm.CompressionSpec.resolve(None) is None
+    with pytest.raises(MXNetError):
+        comm.CompressionSpec("fp8")
+    with pytest.raises(MXNetError):
+        comm.CompressionSpec("int8", chunk=6)  # not a multiple of 4
+
+
+# -- quantize/dequantize kernels ----------------------------------------------
+
+def test_int8_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 512).astype(np.float32)
+    spec = comm.CompressionSpec("int8", chunk=256)
+    d = np.asarray(comm.decode(spec, comm.encode(spec, jnp.asarray(x))))
+    # error <= half an int8 step of the chunk scale
+    scales = np.abs(x).reshape(4, 2, 256).max(-1) / 127.0
+    bound = np.repeat(scales, 256, axis=-1).reshape(x.shape) * 0.5 + 1e-7
+    assert (np.abs(d - x) <= bound).all()
+
+
+def test_twobit_roundtrip_exact_and_packed():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 512).astype(np.float32)
+    spec = comm.CompressionSpec("twobit", threshold=0.3)
+    payload = comm.encode(spec, jnp.asarray(x))
+    assert payload["q"].shape == (2, 128)  # 4 elems per byte
+    d = np.asarray(comm.decode(spec, payload))
+    ref = np.where(x >= 0.3, 0.3, np.where(x <= -0.3, -0.3, 0.0))
+    np.testing.assert_array_equal(d, ref.astype(np.float32))
+    assert comm.payload_nbytes(spec, 512) == 128
+
+
+def test_bf16_roundtrip_and_nbytes():
+    x = np.random.RandomState(2).randn(64).astype(np.float32)
+    spec = comm.CompressionSpec("bf16")
+    d = np.asarray(comm.decode(spec, comm.encode(spec, jnp.asarray(x))))
+    assert np.abs(d - x).max() <= np.abs(x).max() / 128  # 8-bit mantissa
+    assert comm.payload_nbytes(spec, 64) == 128
+
+
+def test_numpy_and_jax_kernels_agree():
+    rng = np.random.RandomState(3)
+    x = rng.randn(1024).astype(np.float32)
+    for mode in ("bf16", "int8", "twobit"):
+        spec = comm.CompressionSpec(mode)
+        pj = comm.encode(spec, jnp.asarray(x))
+        pn = comm.encode(spec, x, xp=np)
+        for k in pj:
+            np.testing.assert_array_equal(np.asarray(pj[k]), pn[k], err_msg=mode)
+        np.testing.assert_array_equal(
+            np.asarray(comm.decode(spec, pj)),
+            comm.decode(spec, pn, xp=np), err_msg=mode)
+
+
+# -- in-jit compressed allreduce ----------------------------------------------
+
+def _shard_allreduce(mesh, g, mode, average=True):
+    def body(gs):
+        out = comm.compressed_allreduce({"w": gs[0]}, mode, "dp",
+                                        axis_size=8, average=average)
+        return out["w"][None]
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"),
+                          out_specs=P("dp"), check_vma=False))
+    return np.asarray(f(g))
+
+
+def test_compressed_allreduce_modes_match_mean():
+    mesh = _mesh8()
+    g = np.random.RandomState(0).randn(8, 1000).astype(np.float32)
+    true = g.mean(0)
+    for mode, tol in ((None, 1e-6), ("bf16", 5e-3), ("int8", 5e-2)):
+        out = _shard_allreduce(mesh, g, mode)
+        assert np.abs(out - true).max() < tol, mode
+        # replicated result: every device row identical
+        assert np.abs(out - out[0]).max() == 0.0, mode
+
+
+def test_compressed_allreduce_none_is_exact_psum():
+    mesh = _mesh8()
+    g = np.random.RandomState(1).randn(8, 64).astype(np.float32)
+    out = _shard_allreduce(mesh, g, None, average=False)
+    np.testing.assert_allclose(out[0], g.sum(0), rtol=1e-6)
+
+
+def test_compressed_allreduce_needs_axis_size():
+    with pytest.raises(MXNetError, match="axis_size"):
+        comm.compressed_allreduce({"w": jnp.ones(8)}, "int8")
+
+
+def test_error_feedback_recovers_quantization_error():
+    """EF property: allreducing the SAME gradient repeatedly, the running
+    mean of outputs converges to the true mean — the residual re-injects
+    what each quantization dropped (without EF the bias persists). Grad
+    scale sits BELOW the ternary threshold: without feedback every step
+    transmits zeros; with it, accumulated residuals fire +/-t pulses whose
+    time-average reconstructs the value (the 2-bit scheme's whole bet)."""
+    mesh = _mesh8()
+    rng = np.random.RandomState(2)
+    g = (rng.randn(8, 1000) * 0.1).astype(np.float32)
+    true = g.mean(0)
+    spec = comm.CompressionSpec("twobit", threshold=0.5)
+
+    def body(gs, rs):
+        out, nr = comm.error_feedback_allreduce(
+            {"w": gs[0]}, rs, spec, "dp", axis_size=8, average=True)
+        return out["w"][None], nr
+
+    step = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                             out_specs=(P("dp"), P("dp")), check_vma=False))
+    resid = comm.init_error_feedback(1000, spec, 8)
+    assert resid.shape[0] == 8 and resid.shape[1] >= 1000
+    acc = np.zeros(1000)
+    r = jnp.asarray(resid)
+    T = 40
+    for _ in range(T):
+        out, r = step(jnp.asarray(g), r)
+        acc += np.asarray(out)[0]
+    ef_drift = np.abs(acc / T - true).max()
+    # one EF-free twobit allreduce of the same grads: the persistent bias
+    # (sub-threshold values transmit as zero, forever)
+    raw = _shard_allreduce(mesh, g, spec)
+    raw_bias = np.abs(raw[0] - true).max()
+    assert ef_drift < raw_bias / 3, (ef_drift, raw_bias)
+    assert ef_drift < 0.05
+
+
+# -- wire-plan arithmetic + HLO cross-check (acceptance) -----------------------
+
+def test_allreduce_plan_ratios():
+    plan = comm.allreduce_plan(8192, 8, "int8")
+    assert plan["ratio"] >= 3.5
+    assert {r["op"] for r in plan["collectives"]} == {"all-to-all",
+                                                      "all-gather"}
+    assert comm.allreduce_plan(8192, 8, None)["ratio"] == 1.0
+    assert comm.allreduce_plan(8192, 8, "bf16")["ratio"] == pytest.approx(2.0)
+    # twobit clears the bar too; its reduce-scatter stage is 4x cheaper
+    # than int8's, but the bf16 all-gather stage (sums of +/-t leave the
+    # 2-bit alphabet) caps the end-to-end ratio near int8's
+    tb = comm.allreduce_plan(8192, 8, "twobit")
+    assert tb["ratio"] >= 3.5
+    a2a = {r["op"]: r for r in tb["collectives"]}["all-to-all"]
+    a2a_int8 = {r["op"]: r for r in
+                comm.allreduce_plan(8192, 8, "int8")["collectives"]
+                }["all-to-all"]
+    assert a2a["wire_bytes"] < a2a_int8["wire_bytes"] / 3
+
+
+def test_int8_hlo_wire_bytes_cut_at_least_3_5x():
+    """ACCEPTANCE: compile the same dp-8 gradient sync uncompressed and
+    int8-compressed; the collective-byte tables extracted from the
+    optimized HLO must show >= 3.5x fewer wire bytes for int8. (int8/uint8
+    payloads are faithfully visible in CPU HLO; bf16 ones are upcast by
+    the CPU backend's float normalization — see comm/stats.py.)"""
+    mesh = _mesh8()
+    L = 8192
+    g = np.random.RandomState(0).randn(8, L).astype(np.float32)
+
+    def build(mode):
+        def body(gs):
+            out = comm.compressed_allreduce({"w": gs[0]}, mode, "dp",
+                                            axis_size=8, average=True)
+            return out["w"][None]
+
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"),
+                              out_specs=P("dp"), check_vma=False))
+        return f.lower(g).compile().as_text()
+
+    wire_fp32 = comm.hlo_collective_wire_bytes(build(None), 8)
+    wire_int8 = comm.hlo_collective_wire_bytes(build("int8"), 8)
+    assert wire_fp32 > 0 and wire_int8 > 0
+    ratio = wire_fp32 / wire_int8
+    assert ratio >= 3.5, f"int8 wire reduction only {ratio:.2f}x"
+    # and the closed-form plan agrees with the compiled reality (2%)
+    plan = comm.allreduce_plan(L, 8, "int8")
+    assert wire_int8 == pytest.approx(plan["wire_bytes"], rel=0.02)
+    table = comm.hlo_collective_table(build("int8"), 8)
+    assert {r["op"] for r in table} >= {"all-to-all", "all-gather"}
+
+
+# -- make_data_parallel_step ---------------------------------------------------
+
+def test_make_data_parallel_step_compression_parity():
+    mesh = _mesh8()
+    rng = np.random.RandomState(4)
+    w_true = rng.randn(16, 1).astype(np.float32)
+    X = rng.randn(64, 16).astype(np.float32)
+    Y = X @ w_true + 0.01 * rng.randn(64, 1).astype(np.float32)
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def update_fn(params, opt_state, grads):
+        return {k: params[k] - 0.05 * grads[k] for k in params}, opt_state
+
+    batch = par.shard_batch({"x": X, "y": Y}, mesh)
+
+    def train(mode, steps=60):
+        params = par.replicate_params(
+            {"w": jnp.zeros((16, 1), jnp.float32)}, mesh)
+        spec = comm.CompressionSpec.resolve(mode)
+        step = par.make_data_parallel_step(loss_fn, update_fn, mesh,
+                                           donate=False, compression=mode)
+        if spec is not None and spec.error_feedback:
+            state = jax.device_put(
+                comm.init_error_feedback(params, spec, 8),
+                NamedSharding(mesh, P("dp")))
+            for _ in range(steps):
+                params, _, loss, state = step(params, {}, batch, state)
+        else:
+            for _ in range(steps):
+                params, _, loss = step(params, {}, batch)
+        return float(loss), np.asarray(params["w"])
+
+    loss_ref, w_ref = train(None)
+    loss_int8, w_int8 = train("int8")
+    assert loss_int8 < 2 * max(loss_ref, 1e-4) + 1e-3
+    assert np.abs(w_int8 - w_ref).max() < 0.05
+
+
+# -- FeedForward fit(compression=...) ------------------------------------------
+
+def _mlp(hidden=300, num_classes=2):
+    # hidden=300 puts the flat grad bucket near its padded size, so the
+    # int8 plan ratio clears the 3.5x acceptance bar (padding amortized)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=hidden)
+    net = sym.Activation(data=net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=num_classes)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _blobs(n=160, dim=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.concatenate([rng.randn(n // 2, dim) + 1,
+                        rng.randn(n - n // 2, dim) - 1]).astype(np.float32)
+    y = np.concatenate([np.zeros(n // 2), np.ones(n - n // 2)]).astype(
+        np.float32)
+    order = rng.permutation(n)
+    return X[order], y[order]
+
+
+def _ctx8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    return [mx.cpu(i) for i in range(8)]
+
+
+def test_fit_int8_convergence_parity_and_wire_accounting():
+    """SATELLITE (convergence parity) + ACCEPTANCE (comm_stats ratio):
+    int8 + error feedback reaches the fp32 final train metric within
+    tolerance on the MLP blobs fit, and the registered per-step plan shows
+    the >= 3.5x wire cut for the actual training program."""
+    X, y = _blobs(160)
+
+    def train(compression):
+        np.random.seed(0)
+        mx.random.seed(0)
+        model = mx.FeedForward(_mlp(), ctx=_ctx8(), num_epoch=5,
+                               learning_rate=0.5,
+                               initializer=mx.init.Xavier())
+        model.fit(X, y, batch_size=32, compression=compression)
+        acc = (model.predict(X, batch_size=32).argmax(axis=1) == y).mean()
+        return acc
+
+    comm.reset_comm_stats()
+    acc_fp32 = train(None)
+    acc_int8 = train("int8")
+    assert acc_fp32 > 0.95
+    assert abs(acc_int8 - acc_fp32) < 0.05, (acc_fp32, acc_int8)
+
+    stats = comm.comm_stats()
+    assert stats["steps"] == 25  # 5 epochs x 5 batches, int8 run only
+    assert stats["wire_bytes"] > 0
+    assert stats["ratio"] >= 3.5, stats["ratio"]
+    (label, prog), = stats["per_program"].items()
+    assert label.startswith("train_step:")
+    assert prog["mode"] == "int8" and prog["ratio"] >= 3.5
+
+
+def test_fit_compression_zero_recompiles_steady_state():
+    """SATELLITE: a RecompileTracker-armed epoch with compression='int8'
+    compiles nothing after epoch 0 — the comm state threads through the
+    donated carry without perturbing the program signature."""
+    X, y = _blobs(160)
+    model = mx.FeedForward(_mlp(hidden=64), ctx=_ctx8(), num_epoch=3,
+                           learning_rate=0.5)
+    tracker = cm.RecompileTracker(raise_on_recompile=True)
+
+    def arm_after_first(epoch, *_):
+        if epoch == 0:
+            tracker.arm()
+
+    cm.reset_compile_stats()
+    try:
+        model.fit(X, y, batch_size=32, compression="int8",
+                  epoch_end_callback=arm_after_first)
+    finally:
+        tracker.disarm()
+    assert tracker.recompiles == []
+    per = cm.compile_stats()["per_function"]
+    train = [c for lbl, c in per.items() if lbl.startswith("train_step:")]
+    assert train and train[0]["misses"] == 1  # compiled exactly once
+
+
+def test_fit_compression_composes_with_guards_and_pad_policy():
+    X, y = _blobs(120)
+    model = mx.FeedForward(_mlp(hidden=64), ctx=_ctx8(), num_epoch=4,
+                           learning_rate=0.5)
+    model.fit(X, y, batch_size=40, compression="int8", guards=True,
+              pad_policy="bucket")
+    acc = (model.predict(X, batch_size=40).argmax(axis=1) == y).mean()
+    assert acc > 0.9, acc
+
+
+def test_fit_compression_single_device_is_ignored():
+    X, y = _blobs(80)
+    model = mx.FeedForward(_mlp(hidden=32), ctx=mx.cpu(), num_epoch=2,
+                           learning_rate=0.5)
+    model.fit(X, y, batch_size=40, compression="int8")  # logs + proceeds
+    acc = (model.predict(X, batch_size=40).argmax(axis=1) == y).mean()
+    assert acc > 0.9
+
+
+def test_precompile_with_compression_then_fit_no_compiles():
+    X, y = _blobs(120)
+    model = mx.FeedForward(_mlp(hidden=64), ctx=_ctx8(), num_epoch=2,
+                           learning_rate=0.5)
+    out = model.precompile(data_shapes={"data": (40, 10)},
+                           label_shapes={"softmax_label": (40,)},
+                           compression="int8")
+    assert out["programs"] == 1
+    with cm.RecompileTracker(raise_on_recompile=True):
+        model.fit(X, y, batch_size=40, compression="int8")
+
+
+# -- bucketing + host codec ----------------------------------------------------
+
+def test_grad_bucketer_pack_unpack_and_caps():
+    shapes = [("a", (100, 10)), ("b", (5000,)), ("c", (300, 300)),
+              ("d", ()), ("e", (7,))]
+    b = comm.GradBucketer(shapes, max_bytes=40_000)  # 10k f32 elems
+    assert b.num_keys == 5
+    # c alone exceeds the cap -> its own bucket
+    sizes = [bk["size"] for bk in b.buckets]
+    assert sum(sizes) == 1000 + 5000 + 90000 + 1 + 7
+    assert all(4 * s <= 40_000 or len(bk["keys"]) == 1
+               for s, bk in zip(sizes, b.buckets))
+    rng = np.random.RandomState(0)
+    kvs = {k: np.asarray(rng.randn(*s), np.float32) for k, s in shapes}
+    out = b.unpack(b.pack(kvs))
+    for k, s in shapes:
+        np.testing.assert_array_equal(out[k], kvs[k], err_msg=k)
+    # layout roundtrip rebuilds the identical partition
+    b2 = comm.GradBucketer.from_layout(b.layout())
+    assert b2.layout() == b.layout()
+    with pytest.raises(MXNetError):
+        b.pack({"a": kvs["a"]})  # missing keys
+
+
+def test_host_codec_roundtrip_and_error_feedback():
+    spec = comm.CompressionSpec("int8")
+    codec = comm.HostCodec(spec)
+    rng = np.random.RandomState(0)
+    g = rng.randn(1000).astype(np.float32)
+    acc = np.zeros(1000, np.float32)
+    T = 30
+    for _ in range(T):
+        acc += codec.decode(codec.encode("slab", g))
+    assert np.abs(acc / T - g).max() < 0.01  # EF keeps the mean honest
+    assert codec.ratio > 3.5
+    # stateless receiver decode
+    payload = codec.encode("other", g)
+    np.testing.assert_array_equal(comm.decode_payload(spec, payload),
+                                  codec.decode(payload))
+
+
+# -- kvstore transports --------------------------------------------------------
+
+def test_group_kvstore_compressed_push():
+    shape = (64, 8)
+    rng = np.random.RandomState(0)
+    init = rng.randn(*shape).astype(np.float32)
+    grads = [rng.randn(*shape).astype(np.float32) for _ in range(2)]
+    group = kvstore.create_group(2, compression="int8")
+
+    def worker(w, g):
+        w.init("w", NDArray(init.copy()))
+        w.push("w", NDArray(g), priority=-1)
+
+    ts = [threading.Thread(target=worker, args=(w, g))
+          for w, g in zip(group, grads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    out = NDArray(np.zeros(shape, np.float32))
+    group[0].pull("w", out, priority=1)
+    true = grads[0] + grads[1]
+    bound = 2 * np.abs(true).max() / 127
+    assert np.abs(out.asnumpy() - true).max() < bound
+    srv = group[0]._server
+    assert srv.raw_bytes_received / srv.wire_bytes_received >= 3.5
+    assert group[0].compression_stats()["ratio"] >= 3.5
+
+
+def test_dist_kvstore_push_bucketed_and_bf16():
+    kv = kvstore.create("dist_sync")
+    kv.set_gradient_compression("bf16")
+    rng = np.random.RandomState(0)
+    keys = [f"k{i}" for i in range(5)]
+    vals = {k: rng.randn(300, 7).astype(np.float32) for k in keys}
+    for k in keys:
+        kv.init(k, NDArray(np.zeros((300, 7), np.float32)))
+    kv.push_bucketed({k: NDArray(v) for k, v in vals.items()}, priority=3)
+    out = NDArray(np.zeros((300, 7), np.float32))
+    kv.pull("k3", out)
+    assert np.abs(out.asnumpy() - vals["k3"]).max() < \
+        np.abs(vals["k3"]).max() / 100  # bf16 rounding only
+    with pytest.raises(MXNetError, match="bf16"):
+        kv.set_gradient_compression("int8")
+
+
+def test_async_kvstore_compressed_push_pull_and_stats():
+    akv = kvstore.create("dist_async")
+    try:
+        akv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0,
+                                           rescale_grad=1.0))
+        rng = np.random.RandomState(0)
+        w0 = {k: rng.randn(100).astype(np.float32) for k in ("a", "b")}
+        for k, v in w0.items():
+            akv.init(k, NDArray(v.copy()))
+        spec = akv.set_gradient_compression(
+            {"type": "2bit", "threshold": 0.05})
+        assert spec.mode == "twobit"
+        grads = {k: np.full(100, 0.05 * (1 if k == "a" else -1), np.float32)
+                 for k in w0}
+        new = akv.push_pull(grads, priority=0)
+        for k in w0:
+            np.testing.assert_allclose(new[k], w0[k] - grads[k], atol=1e-5)
+        akv.push_many(grads, priority=-1)
+        st = akv.stats()
+        assert st["update_count"] == 2
+        assert st["raw_bytes_received"] / st["wire_bytes_received"] > 3.5
+        assert akv.compression_stats()["ratio"] > 3.5
+        _ = akv.pull_many(["a", "b"], priority=2)
+        # the static key layout ships once, then travels as a hash
+        assert len(akv._server._layouts) == 1
+        # a DIFFERENT key set rebuilds the bucketer (new layout cached)
+        # and resets the error-feedback ledger — slab names are reused
+        # across layouts, so stale residuals must not cross-inject
+        akv.push_many({"a": grads["a"]})
+        assert len(akv._server._layouts) == 2
+        akv.push_many(grads)  # back to the full set: cached layout reused
+        assert len(akv._server._layouts) == 2
+        assert akv.stats()["update_count"] == 4
+    finally:
+        del akv
+
+
+def test_async_kvstore_per_request_spec_decode():
+    """The *_enc wire ops carry their spec IN the request: re-arming a
+    different mode mid-run must not mis-decode in-flight-style pushes
+    (a server-global spec would decode int8 codes as bf16 garbage)."""
+    akv = kvstore.create("dist_async")
+    try:
+        akv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0,
+                                           rescale_grad=1.0))
+        rng = np.random.RandomState(1)
+        w0 = rng.randn(512).astype(np.float32)
+        akv.init("w", NDArray(w0.copy()))
+        akv.set_gradient_compression("int8")
+        g1 = rng.randn(512).astype(np.float32)
+        akv.push_many({"w": g1})
+        akv.set_gradient_compression({"type": "2bit", "threshold": 0.05})
+        new = akv.push_pull({"w": np.full(512, 0.05, np.float32)})
+        # int8 push then twobit push both decoded with their own spec:
+        # result tracks w0 - g1 - 0.05 within the int8 quantization error
+        bound = np.abs(g1).max() / 127 + 1e-5
+        assert np.abs(new["w"] - (w0 - g1 - 0.05)).max() < bound
+    finally:
+        del akv
+
+
+def test_priority_kwarg_uniform_across_stores():
+    """SATELLITE: priority= is accepted (and ignored) on every data-plane
+    method of every store type, including the bulk variants and the
+    RetryingKVStore wrapper."""
+    from mxnet_tpu.resilience.retry import RetryingKVStore
+
+    kv = kvstore.create("local")
+    kv.init("x", NDArray(np.zeros(4, np.float32)))
+    kv.push("x", NDArray(np.ones(4, np.float32)), priority=5)
+    out = NDArray(np.zeros(4, np.float32))
+    kv.pull("x", out, priority=-5)
+    np.testing.assert_array_equal(out.asnumpy(), np.ones(4))
+
+    rkv = RetryingKVStore(kvstore.create("local"))
+    rkv.init("x", NDArray(np.zeros(4, np.float32)))
+    rkv.push("x", NDArray(np.ones(4, np.float32)), priority=1)
+    rkv.pull("x", out, priority=1)
+    # bulk surface accepts priority uniformly (inner local store has no
+    # bulk ops; the signature contract is what's under test)
+    import inspect
+
+    for cls in (kvstore.KVStore, RetryingKVStore):
+        for name in ("push", "pull"):
+            assert "priority" in inspect.signature(
+                getattr(cls, name)).parameters, (cls, name)
+    from mxnet_tpu.kvstore_async import AsyncKVStore
+
+    for name in ("push", "pull", "push_many", "pull_many", "push_pull"):
+        assert "priority" in inspect.signature(
+            getattr(AsyncKVStore, name)).parameters, name
+    for name in ("push_many", "pull_many", "push_pull"):
+        assert "priority" in inspect.signature(
+            getattr(RetryingKVStore, name)).parameters, name
+
+
+# -- observability -------------------------------------------------------------
+
+def test_comm_registry_and_monitor_rows():
+    reg = comm.registry()
+    comm.reset_comm_stats()
+    mon = mx.Monitor(interval=1, track_comm=True)
+    reg.register_plan("unit:prog", comm.allreduce_plan(4096, 8, "int8"))
+    reg.record_step("unit:prog", count=3)
+    rows = mon.collect_comm()
+    by = {name: v for _, name, v in rows}
+    assert by["comm/steps"] == 3
+    assert by["comm/wire_bytes"] > 0
+    assert by["comm/fp32_wire_bytes"] > by["comm/wire_bytes"]
+    # second collection: deltas, not totals
+    rows = mon.collect_comm()
+    assert {name: v for _, name, v in rows}["comm/steps"] == 0
+
+
+def test_comm_report_formats():
+    from mxnet_tpu.utils import profiler
+
+    comm.reset_comm_stats()
+    reg = comm.registry()
+    reg.register_plan("unit:report", comm.allreduce_plan(8192, 8, "twobit"))
+    reg.record_step("unit:report", count=2)
+    report = profiler.comm_report()
+    assert "unit:report" in report and "twobit" in report
+    assert "all-to-all" in report
+
+
+def test_jaxpr_audit_reports_collectives():
+    from mxnet_tpu.analysis.jaxpr_audit import audit_jaxpr
+
+    mesh = _mesh8()
+
+    def body(xs):
+        return jax.lax.psum(xs, "dp")
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P(),
+                  check_vma=False)
+    closed = jax.make_jaxpr(f)(np.ones((8, 16), np.float32))
+    rep = audit_jaxpr(closed)
+    assert rep.comm_rows and rep.comm_rows[0]["op"] == "psum"
+    assert rep.totals["comm_payload_bytes"] > 0

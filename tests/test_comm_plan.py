@@ -103,3 +103,36 @@ def test_comm_plan_reports_byte_sizes():
                 n *= int(d)
             total += 4 * n
     assert total > 0, "could not extract all-reduce payload sizes from HLO"
+
+
+def test_comm_subsystem_table_agrees_with_local_parse():
+    """comm.hlo_collective_table generalizes this module's ad-hoc parsing
+    (opcode counts + payload bytes + ring-factor wire bytes); the two must
+    agree on the dp-only transformer plan."""
+    from mxnet_tpu import comm
+
+    hlo = _compiled_hlo(dp=8, tp=1, sp=1)
+    table = {r["op"]: r for r in comm.hlo_collective_table(
+        hlo, default_group_size=8)}
+    assert "all-reduce" in table
+    assert table["all-reduce"]["count"] == _count(hlo, "all-reduce")
+    assert "collective-permute" not in table
+    ar = table["all-reduce"]
+    assert ar["payload_bytes"] > 0
+    # ring all-reduce wire factor: 2*(n-1)/n of the payload
+    assert ar["wire_bytes"] == pytest.approx(
+        2 * 7 / 8 * ar["payload_bytes"], rel=1e-6)
+    assert comm.hlo_collective_wire_bytes(hlo, 8) >= ar["wire_bytes"]
+
+
+def test_sp_ring_permutes_counted_by_comm_table():
+    from mxnet_tpu import comm
+
+    hlo = _compiled_hlo(dp=2, tp=1, sp=2)
+    table = {r["op"]: r for r in comm.hlo_collective_table(
+        hlo, default_group_size=2)}
+    assert table["collective-permute"]["count"] == \
+        _count(hlo, "collective-permute")
+    # permute wire = payload exactly (point-to-point)
+    assert table["collective-permute"]["wire_bytes"] == \
+        table["collective-permute"]["payload_bytes"]

@@ -112,6 +112,36 @@ def test_prefetching_iter():
         np.testing.assert_allclose(a, b)
 
 
+def test_prefetching_iter_reset_mid_epoch_drains_queue():
+    """Regression for the deque future queue: a reset() mid-epoch must
+    drain the in-flight prefetch futures and restart cleanly from the
+    epoch head — no stale batch from the abandoned epoch may leak, and
+    the full epoch after the reset matches an undisturbed pass."""
+    data = np.arange(64 * 3, dtype=np.float32).reshape(64, 3)
+    ref = [b.data[0].asnumpy()
+           for b in mio.NDArrayIter(data, np.zeros(64, np.float32),
+                                    batch_size=8)]
+    base = mio.NDArrayIter(data, np.zeros(64, np.float32), batch_size=8)
+    pf = mio.PrefetchingIter(base, depth=4)
+    pf.reset()
+    for _ in range(3):  # abandon the epoch with futures still queued
+        pf.next()
+    assert len(pf._queue) > 0  # in-flight work to drain
+    pf.reset()
+    fresh = []
+    while True:
+        try:
+            fresh.append(pf.next().data[0].asnumpy())
+        except StopIteration:
+            break
+    assert len(fresh) == len(ref)
+    for a, b in zip(fresh, ref):
+        np.testing.assert_allclose(a, b)
+    # and the NEXT epoch still starts at the head (exhaustion handled)
+    pf.reset()
+    np.testing.assert_allclose(pf.next().data[0].asnumpy(), ref[0])
+
+
 def test_csv_iter(tmp_path):
     data = np.random.uniform(size=(20, 4)).astype(np.float32)
     labels = np.arange(20, dtype=np.float32)

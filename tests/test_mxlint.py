@@ -186,6 +186,57 @@ def test_pragma_suppression():
     assert _ids(lint_source(src3, "fx.py")) == ["MX101"]
 
 
+# -- MX304: raw gradient psum outside the comm subsystem (ISSUE 4) ------------
+
+def test_fixture_mx304_direct_psum_on_grads():
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def sync(grads, ax):\n"
+        "    return lax.psum(grads, ax)\n"
+    )
+    findings = lint_source(src, "fx.py")
+    assert _ids(findings) == ["MX304"]
+    assert not findings[0].is_error  # perf warning, not a gate
+
+
+def test_fixture_mx304_tree_map_lambda_psum():
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def sync(grads, ax):\n"
+        "    return jax.tree_util.tree_map(\n"
+        "        lambda g: lax.psum(g, ax), grads)\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == ["MX304"]
+
+
+def test_fixture_mx304_clean_patterns():
+    # psum of a scalar constant (axis-size probe) is not gradient traffic
+    src = (
+        "from jax import lax\n"
+        "def axis_size(ax):\n"
+        "    return lax.psum(1, ax)\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+    # the comm package is the sanctioned home for raw gradient psums
+    src2 = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def sync(grads, ax):\n"
+        "    return lax.psum(grads, ax)\n"
+    )
+    assert _ids(lint_source(src2, "mxnet_tpu/comm/allreduce.py")) == []
+    # pragma suppression works like every other rule
+    src3 = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def sync(grads, ax):\n"
+        "    return lax.psum(grads, ax)  # mxlint: disable=MX304\n"
+    )
+    assert _ids(lint_source(src3, "fx.py")) == []
+
+
 # -- MX6xx robustness fixtures (ISSUE 2 satellite) ----------------------------
 
 def test_fixture_bare_except_is_mx601():
