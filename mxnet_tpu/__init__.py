@@ -20,6 +20,11 @@ Layering (cf. SURVEY.md §1):
                              kvstore transport + circuit breaker, step
                              guards/watchdog, preemption-safe checkpoints
                              (doc/developer-guide/resilience.md)
+  telemetry/               - observability: metrics hub (counters/gauges/
+                             histograms + event ring), per-step timeline
+                             tracing, MFU/goodput accounting, Prometheus/
+                             JSONL/Chrome-trace exporters
+                             (doc/developer-guide/telemetry.md)
 """
 
 # Join the jax.distributed world BEFORE anything touches a backend: under
@@ -99,5 +104,10 @@ from . import predictor as _predictor_mod
 from .predictor import Predictor
 from . import analysis
 from . import resilience
+from . import telemetry
+
+# Background /metrics endpoint (Prometheus text): opt-in via
+# MXNET_TPU_METRICS_PORT so long-running jobs are scrapable with zero code.
+telemetry.maybe_serve_http_from_env()
 
 __version__ = "0.1.0"

@@ -158,6 +158,17 @@ register_rule(
     "parallel.allreduce_grads, which add quantized wire formats, fused "
     "bucketing, and comm_stats() byte accounting")
 
+register_rule(
+    "MX306", "warning",
+    "un-barriered wall-clock delta around device dispatch: a "
+    "time.time()/perf_counter() start/stop pair with work between and no "
+    "block_until_ready/barrier/wait — under async dispatch this measures "
+    "enqueue cost, not execution (the timing footgun the telemetry layer "
+    "exists to prevent)",
+    "block on the outputs before reading the clock (utils.profiler.Timer "
+    "with t.block(out), or jax.block_until_ready), or route the "
+    "measurement through mxnet_tpu.telemetry (timed() / StepTimeline)")
+
 # MX4xx — graph verifier (Symbol.verify)
 register_rule(
     "MX401", "error",

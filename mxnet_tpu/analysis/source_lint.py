@@ -8,6 +8,9 @@ Catches, before anything imports or traces:
                float()/int() on traced values, Python branches on tracers),
   MX301-302    recompilation risks (unhashable static-arg containers,
                string formatting under trace),
+  MX306        un-barriered wall-clock deltas around device dispatch
+               (timing the enqueue instead of the execution; telemetry/
+               and utils/profiler are the sanctioned timing homes),
   MX601-602    robustness hazards (bare ``except:``; ``while True`` retry
                loops that swallow exceptions with no backoff/deadline —
                the loop shape that melts a parameter server under a
@@ -427,6 +430,115 @@ class _TracedWalk(ast.NodeVisitor):
         # no generic_visit: one finding per f-string
 
 
+# -- MX306: un-barriered wall-clock deltas around device dispatch -------------
+# The timing footgun: `t0 = time.time(); out = step(x); dt = time.time()-t0`
+# measures ENQUEUE cost under async dispatch, not execution. The scan is
+# function-local and zero-FP-biased: it only fires when a time.time()/
+# perf_counter() start is subtracted later in the same function, actual
+# work (a non-trivial call) happens between, and nothing in between is
+# barrier-shaped. time.monotonic() is exempt (deadline/backoff bookkeeping,
+# never a measurement), as are telemetry/ and utils/profiler — the two
+# sanctioned homes for timing.
+
+_WALL_CLOCK_CALLS = ("time.time", "time.perf_counter")
+# call-name fragments treated as blocking before the clock is read
+_TIMING_BARRIER_PARTS = ("block", "barrier", "wait", "sync", "join",
+                         "result", "asnumpy", "compile", "ready")
+# calls that are not "work being timed" on their own
+_TIMING_TRIVIAL_CALLS = {
+    "len", "min", "max", "int", "float", "str", "abs", "round", "sorted",
+    "sum", "isinstance", "getattr", "setattr", "hasattr", "repr", "next",
+    "iter", "enumerate", "zip", "range", "list", "dict", "tuple", "set",
+    "print", "format", "debug", "info", "warning", "error", "exception",
+    "log", "append", "items", "keys", "values", "get", "pop", "update",
+}
+
+
+def _exempt_timing_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return "/telemetry/" in p or p.endswith("utils/profiler.py") or \
+        p.endswith("telemetry/__init__.py")
+
+
+def _is_wall_clock_call(node, imports):
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = _dotted(node.func, imports)
+    return dotted in _WALL_CLOCK_CALLS
+
+
+class _FnTimingScan(ast.NodeVisitor):
+    """One function body: clock-start assignments, barrier/work call lines,
+    and clock-delta expressions. Nested defs/lambdas are their own scope
+    and are skipped (the driver visits them separately)."""
+
+    def __init__(self, imports):
+        self.imports = imports
+        self.assigns = {}        # name -> latest assignment lineno
+        self.barrier_lines = []
+        self.work_lines = []
+        self.deltas = []         # (lineno, col, start_name)
+
+    def visit_FunctionDef(self, node):  # separate scope
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name) \
+                and _is_wall_clock_call(node.value, self.imports):
+            self.assigns[node.targets[0].id] = node.lineno
+        else:
+            self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            getattr(f, "id", "")
+        lname = name.lower()
+        if _is_wall_clock_call(node, self.imports):
+            pass  # reading the clock is not the work being timed
+        elif any(part in lname for part in _TIMING_BARRIER_PARTS):
+            self.barrier_lines.append(node.lineno)
+        elif name and name not in _TIMING_TRIVIAL_CALLS:
+            self.work_lines.append(node.lineno)
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node):
+        if isinstance(node.op, ast.Sub) and isinstance(node.right, ast.Name):
+            left_ok = _is_wall_clock_call(node.left, self.imports) or (
+                isinstance(node.left, ast.Name)
+                and node.left.id in self.assigns)
+            if left_ok and node.right.id in self.assigns:
+                self.deltas.append((node.lineno, node.col_offset,
+                                    node.right.id))
+        self.generic_visit(node)
+
+
+def _scan_unbarriered_timing(tree, path, imports, findings):
+    if _exempt_timing_path(path):
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scan = _FnTimingScan(imports)
+        for stmt in fn.body:
+            scan.visit(stmt)
+        for lineno, col, start in scan.deltas:
+            l0 = scan.assigns.get(start)
+            if l0 is None or l0 >= lineno:
+                continue
+            worked = any(l0 < l < lineno for l in scan.work_lines)
+            barriered = any(l0 < l < lineno for l in scan.barrier_lines)
+            if worked and not barriered:
+                findings.append(Finding(
+                    get_rule("MX306"),
+                    f"wall-clock delta `... - {start}` times dispatched "
+                    "work with no barrier between start and read",
+                    path=path, line=lineno, col=col))
+
+
 # calls whose presence inside a retry loop counts as bounding it: anything
 # sleep/backoff/wait-shaped (time.sleep, policy backoff, cv.wait_for, ...)
 _BOUNDING_CALL_PARTS = ("sleep", "backoff", "wait", "delay", "retry_call",
@@ -528,6 +640,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     scan = _ModuleScan(path)
     scan.visit(tree)
     _scan_robustness(tree, path, scan.findings)
+    _scan_unbarriered_timing(tree, path, scan.imports, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)
     roots += [d for d in scan.defs if d.name in scan.traced_names]

@@ -40,7 +40,20 @@ def log_train_metric(period):
 
 
 class Speedometer:
-    """Logs samples/sec every ``frequent`` batches (reference: callback.py:62-95)."""
+    """Logs samples/sec every ``frequent`` batches (reference: callback.py:62-95).
+
+    Rebased on the telemetry hub: every reported window also lands as a
+    ``samples_per_sec`` gauge/histogram, so exporters see what the log
+    line says.
+
+    Warm-up skew fix: the reference implementation's first window silently
+    included jit/XLA compile time, deflating the first samples/sec report
+    by whatever the compile cost (minutes on a real pod). The window timer
+    now consults the compile registry (utils/compile): a window in which
+    any XLA compile landed is *not reported as throughput* — the compile
+    seconds are attributed to ``badput_compile_seconds_total`` instead and
+    the timer resets on that first post-compile batch, so the first number
+    printed is a steady-state number."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
@@ -48,35 +61,72 @@ class Speedometer:
         self.init = False
         self.tic = 0.0
         self.last_count = 0
+        self._compile_snap = None
+
+    def _compiles_in_window(self):
+        """(compiles_delta, compile_seconds_delta) since the last call;
+        updates the snapshot."""
+        from .utils import compile as compile_mod
+
+        snap = compile_mod.registry().snapshot()
+        prev = self._compile_snap or snap
+        self._compile_snap = snap
+        return (snap["compiles"] - prev["compiles"],
+                snap["compile_seconds"] - prev["compile_seconds"])
 
     def __call__(self, param: BatchEndParam):
+        from . import telemetry
+
         count = param.nbatch
         if self.last_count > count:
             self.init = False
         self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                logging.info(
-                    "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                    param.epoch, count, speed,
-                )
-                self.tic = time.time()
-        else:
+        if not self.init:
             self.init = True
+            self._compiles_in_window()  # baseline the registry snapshot
             self.tic = time.time()
+            return
+        if count % self.frequent != 0:
+            return
+        compiles, compile_s = self._compiles_in_window()
+        if compiles:
+            # the window is polluted by compile time: report it as badput,
+            # not as (deflated) throughput, and restart the clock. Deduped
+            # against MFU epoch accounting observing the same registry
+            # delta (telemetry.record_compile_badput watermark).
+            telemetry.record_compile_badput(
+                self._compile_snap["compile_seconds"], compile_s,
+                epoch=param.epoch)
+            logging.info(
+                "Iter[%d] Batch [%d]\tSpeed: (window skipped: %d XLA "
+                "compile(s), %.2fs — counted as badput/compile)",
+                param.epoch, count, compiles, compile_s)
+            self.tic = time.time()
+            return
+        speed = self.frequent * self.batch_size / (time.time() - self.tic)
+        telemetry.gauge("samples_per_sec", speed)
+        telemetry.observe("samples_per_sec_window", speed)
+        logging.info(
+            "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+            param.epoch, count, speed,
+        )
+        self.tic = time.time()
 
 
 class ProgressBar:
-    """Text progress bar per epoch (reference: callback.py ProgressBar)."""
+    """Text progress bar per epoch (reference: callback.py ProgressBar);
+    mirrors progress into a telemetry ``epoch_progress_pct`` gauge."""
 
     def __init__(self, total, length=80):
         self.bar_len = length
         self.total = total
 
     def __call__(self, param: BatchEndParam):
+        from . import telemetry
+
         count = param.nbatch
         filled_len = int(round(self.bar_len * count / float(self.total)))
         percents = int(round(100.0 * count / float(self.total)))
+        telemetry.gauge("epoch_progress_pct", percents, epoch=param.epoch)
         prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
         sys.stdout.write(f"[{prog_bar}] {percents}%\r")

@@ -431,6 +431,9 @@ class Executor:
         return self._outputs
 
     def forward(self, is_train=False, **kwargs):
+        from . import telemetry
+
+        telemetry.counter("executor_forward_total")
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError(f"forward: unknown argument {k!r}")
@@ -520,6 +523,9 @@ class Executor:
         heads ignore the seed by construction (see ops/loss.py)."""
         if self._last is None:
             raise MXNetError("backward() requires a prior forward(is_train=True)")
+        from . import telemetry
+
+        telemetry.counter("executor_backward_total")
         arg_vals, aux_vals, rng = self._last
         diff_names = self._diff_names()
         if not diff_names:

@@ -325,6 +325,9 @@ class _DistKVStore(KVStore):
         bf16 compression armed (set_gradient_compression) the slab
         crosses the wire as bf16 and accumulates in f32."""
         del priority
+        from . import telemetry
+
+        telemetry.counter("kvstore_push_pull_total")
         arrays = {}
         for k, v in kvs.items():
             if k not in self._store:
@@ -527,9 +530,11 @@ class _GroupWorkerKVStore(KVStore):
         (reference analog: ps-lite retransmission with per-message ids).
         The retry loop only engages when a send actually fails."""
         del priority
+        from . import telemetry
         from .resilience import chaos as chaos_mod
         from .resilience.retry import RetryPolicy, retry_call
 
+        telemetry.counter("kvstore_push_pull_total")
         if self._retry_policy is None:
             self._retry_policy = RetryPolicy(seed=self._rank)
         for k, vlist in self._as_pairs(key, value):

@@ -670,28 +670,41 @@ class AsyncKVStore(KVStore):
         """Push {key: numpy grad} in ONE round trip (the per-batch trainer
         path: serialized per-key round trips would dominate step time)."""
         del priority
-        if self._codec is not None:
-            self._call_enc("push_many_enc", kvs)
-            return
-        self._call("push_many",
-                   {k: np.asarray(v, np.float32) for k, v in kvs.items()},
-                   mutating=True)
+        from . import telemetry
+
+        with telemetry.phase("kvstore_push"):
+            if self._codec is not None:
+                self._call_enc("push_many_enc", kvs)
+                return
+            self._call("push_many",
+                       {k: np.asarray(v, np.float32) for k, v in kvs.items()},
+                       mutating=True)
 
     def pull_many(self, keys, priority=0) -> dict:
         """Pull current values for ``keys`` in one round trip."""
         del priority
-        return self._call("pull_many", list(keys))
+        from . import telemetry
+
+        with telemetry.phase("kvstore_pull"):
+            return self._call("pull_many", list(keys))
 
     def push_pull(self, kvs: dict, priority=0) -> dict:
         """Apply grads and return the updated weights in ONE round trip —
         the trainer's whole per-batch parameter-host sync. With
-        compression armed the grads cross the socket quantized+bucketed."""
+        compression armed the grads cross the socket quantized+bucketed.
+        The round trip reports into the telemetry hub (a
+        ``kvstore_push_pull_seconds`` histogram sample + per-step timeline
+        phase when a step span is in flight)."""
         del priority
-        if self._codec is not None:
-            return self._call_enc("push_pull_enc", kvs)
-        return self._call("push_pull",
-                          {k: np.asarray(v, np.float32)
-                           for k, v in kvs.items()}, mutating=True)
+        from . import telemetry
+
+        telemetry.counter("kvstore_push_pull_total")
+        with telemetry.phase("kvstore_push_pull"):
+            if self._codec is not None:
+                return self._call_enc("push_pull_enc", kvs)
+            return self._call("push_pull",
+                              {k: np.asarray(v, np.float32)
+                               for k, v in kvs.items()}, mutating=True)
 
     def compression_stats(self) -> dict:
         """Client-side wire accounting for the compressed push path."""

@@ -48,6 +48,7 @@ from . import ndarray as nd
 from . import optimizer as opt_mod
 from . import random as random_mod
 from . import symbol as sym_mod
+from . import telemetry as telemetry_mod
 from .resilience import chaos as chaos_mod
 from .resilience import guards as guards_mod
 from .resilience import preempt as preempt_mod
@@ -300,6 +301,20 @@ def _snapshot_batch(batch):
     return _FeedBatchView(batch, label)
 
 
+def _timed_feed(feed, tl):
+    """Wrap the device feed so time blocked waiting for the next batch is
+    banked on the timeline as the following step's ``data_wait`` phase."""
+    it = iter(feed)
+    while True:
+        t0 = tl.clock()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        tl.note_data_wait(tl.clock() - t0)
+        yield item
+
+
 def _create_kvstore(kvstore, num_device, arg_params):
     """Reference: model.py:126-169 — resolve the kvstore strategy."""
     if kvstore is None:
@@ -356,6 +371,10 @@ class FeedForward(BASE_ESTIMATOR):
         state["_graph_fps"] = {}
         state.pop("_optimizer_obj", None)
         state.pop("_opt_cache", None)
+        # timelines hold the live hub (locks, deques) — session state, not
+        # model state
+        state.pop("telemetry", None)
+        state.pop("_active_timeline", None)
         return state
 
     def __setstate__(self, state):
@@ -872,7 +891,7 @@ class FeedForward(BASE_ESTIMATOR):
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, batch_size=128,
             sharded_checkpoint_dir=None, guards=None, pad_policy=None,
-            compression=None):
+            compression=None, telemetry=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -912,10 +931,26 @@ class FeedForward(BASE_ESTIMATOR):
         parity); with kvstore='dist_async' the spec is forwarded to
         ``kv.set_gradient_compression`` so pushes cross the socket
         quantized. Wire accounting: ``comm.comm_stats()`` and the
-        per-epoch ``Comm:`` log line (doc/developer-guide/comm.md)."""
+        per-epoch ``Comm:`` log line (doc/developer-guide/comm.md).
+
+        ``telemetry``: observability control — None (default; env gate
+        ``MXNET_TPU_TELEMETRY``), True, a JSONL path, or a
+        telemetry.TelemetryConfig. When on, the loop records a
+        StepTimeline (one span per step: data_wait / dispatch / device /
+        [kvstore] / host phases, guard retries as instant events), logs
+        per-epoch ``MFU:`` and ``Goodput:`` lines (FLOPs from the jaxpr
+        audit table; badput attributed to compile, data stalls, checkpoint
+        flushes, and wasted steps), and exports through the metrics hub
+        (Prometheus / JSONL / Chrome trace). The timeline lands on
+        ``self.telemetry`` (``.dump_chrome_trace(path)``,
+        ``.dump_jsonl(path)``). Exact device timing blocks on each step's
+        outputs — that trades feed/compute overlap for attribution
+        (doc/developer-guide/telemetry.md); ``TelemetryConfig(sync=False)``
+        keeps the overlap."""
         del work_load_list
         guard_cfg = guards_mod.GuardConfig.resolve(guards)
         pad_policy = compile_mod.PadPolicy.resolve(pad_policy)
+        tcfg = telemetry_mod.TelemetryConfig.resolve(telemetry)
         from . import comm as comm_mod
 
         comm_spec = comm_mod.CompressionSpec.resolve(compression)
@@ -1106,6 +1141,29 @@ class FeedForward(BASE_ESTIMATOR):
 
         feed_depth = int(os.environ.get("MXTPU_FEED_PREFETCH", "2"))
 
+        # -- telemetry wiring (tl None = the loop takes the exact
+        # pre-instrumentation path; doc/developer-guide/telemetry.md) ------
+        tl = None
+        mfu_acct = None
+        tel_sink = None
+        if tcfg is not None:
+            if tcfg.timeline:
+                tl = telemetry_mod.StepTimeline()
+                self.telemetry = tl
+            if tcfg.mfu:
+                mfu_acct = telemetry_mod.MFUAccountant(
+                    num_devices=int(mesh.shape["dp"]) if mesh is not None
+                    else 1)
+            if tcfg.jsonl:
+                tel_sink = telemetry_mod.hub().add_sink(
+                    telemetry_mod.JsonlWriter(tcfg.jsonl))
+        self._active_timeline = tl
+
+        def _ckpt_seconds():
+            h = telemetry_mod.hub().snapshot()["histograms"].get(
+                "checkpoint_save_seconds")
+            return h["sum"] if h else 0.0
+
         eval_metric = metric_mod.create(eval_metric)
         # Device-resident metric accumulation whenever the metric supports it
         # and nothing needs per-batch host values: the (sum, count) scalars
@@ -1172,6 +1230,12 @@ class FeedForward(BASE_ESTIMATOR):
             host_comm_snap = kv.compression_stats() \
                 if async_comm_spec is not None and \
                 hasattr(kv, "compression_stats") else None
+            epoch_span_base = len(tl.spans) if tl is not None else 0
+            ckpt_base = _ckpt_seconds() if mfu_acct is not None else 0.0
+            retries_base = self.guard_stats["step_retries"] \
+                if guard_cfg is not None else 0
+            skipped_base = self.guard_stats["skipped_steps"] \
+                if guard_cfg is not None else 0
             eval_metric.reset()
             maccum = self._DeviceMetricAccum(eval_metric)
             nbatch = 0
@@ -1183,13 +1247,22 @@ class FeedForward(BASE_ESTIMATOR):
             else:  # MXTPU_FEED_PREFETCH=0: synchronous feed (debugging)
                 feed = ((b, _place_batch(_extract_batch(b)))
                         for b in train_data)
+            feed_src = _timed_feed(feed, tl) if tl is not None else feed
             try:
-                for batch, batch_arrays in feed:
+                for batch, batch_arrays in feed_src:
+                    span = tl.begin_step(epoch, nbatch) if tl is not None \
+                        else None
                     if preempt_handler is not None and \
                             preempt_mod.preemption_requested():
                         _preempt_flush()
                     if watchdog is not None:
                         watchdog.check()
+                    if span is not None:
+                        # dispatch opens as soon as the batch is in hand:
+                        # program-cache resolution / first-step graph
+                        # build / the one-time FLOP trace are launch-side
+                        # host work, not a data stall
+                        span.mark("dispatch")
                     bkey = getattr(batch, "bucket_key", None)
                     b_dnames = getattr(batch, "data_names", data_names)
                     b_lnames = getattr(batch, "label_names", label_names)
@@ -1207,6 +1280,20 @@ class FeedForward(BASE_ESTIMATOR):
                     rng = random_mod.next_key()
                     lr = optimizer._get_lr()
                     optimizer.num_update = num_update
+                    if mfu_acct is not None and \
+                            mfu_acct.flops_per_step is None and \
+                            getattr(train_step, "_tracked", None) is not None:
+                        # abstract-trace the exact program about to
+                        # dispatch (shapes only, pre-donation) for the
+                        # jaxpr FLOP table behind the MFU line
+                        mfu_tail = () if guard_cfg is None else (gstate,)
+                        if cstate is not None:
+                            mfu_tail += (cstate,)
+                        mfu_acct.maybe_trace(
+                            train_step._tracked._jitted,
+                            (params, opt_state, aux, batch_arrays, rng,
+                             jnp.float32(lr), maccum.state) + mfu_tail
+                            + pad_tail)
                     # state tail mirrors the step signature:
                     # [gstate][cstate][valid]
                     if guard_cfg is None:
@@ -1236,8 +1323,19 @@ class FeedForward(BASE_ESTIMATOR):
                                     raise
                                 retries -= 1
                                 self.guard_stats["step_retries"] += 1
+                                telemetry_mod.counter(
+                                    "resilience_step_retries_total")
+                                if span is not None:
+                                    span.event("step_retry")
                         if watchdog is not None:
                             watchdog.beat()
+                    if span is not None:
+                        span.mark("device")
+                        if tcfg.sync:
+                            # exact device phase: wait for the step's
+                            # output buffers (see TelemetryConfig.sync)
+                            jax.block_until_ready(res)
+                        span.mark("kvstore" if async_kv else "host")
                     params, opt_state, aux, outs, maccum.state = res[:5]
                     idx = 5
                     if guard_cfg is not None:
@@ -1270,6 +1368,8 @@ class FeedForward(BASE_ESTIMATOR):
                             pulled = kv.pull_many(param_names)
                         params = {k: jnp.asarray(pulled[k])
                                   for k in param_names}
+                    if span is not None and async_kv:
+                        span.mark("host")
                     num_update += 1
                     if use_device_metric:
                         maccum.after_batch(batch.label)
@@ -1297,11 +1397,17 @@ class FeedForward(BASE_ESTIMATOR):
                                           eval_metric=eval_metric)
                         for cb in _as_list(batch_end_callback):
                             cb(p)
+                    if span is not None:
+                        span.end()
             finally:
                 if feed_depth > 0:
                     feed.close()
             if use_device_metric:
                 maccum.finish()
+            # stop the epoch clock only once the last step's buffers are
+            # ready — a returned dispatch is not a finished step (the
+            # un-barriered-timing footgun, mxlint MX306)
+            jax.block_until_ready(jax.tree_util.tree_leaves(params)[:1])
             name, value = eval_metric.get()
             logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
             logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
@@ -1349,6 +1455,13 @@ class FeedForward(BASE_ESTIMATOR):
                     _host_local(gstate["skipped"])))
                 self.guard_stats["loss_scale"] = float(np.asarray(
                     _host_local(gstate["scale"])))
+                skipped_delta = self.guard_stats["skipped_steps"] \
+                    - skipped_base
+                if skipped_delta > 0:
+                    telemetry_mod.counter("resilience_skipped_steps_total",
+                                          skipped_delta)
+                telemetry_mod.gauge("loss_scale",
+                                    self.guard_stats["loss_scale"])
                 if self.guard_stats["skipped_steps"] or \
                         self.guard_stats["step_retries"]:
                     logger.info(
@@ -1366,6 +1479,24 @@ class FeedForward(BASE_ESTIMATOR):
                     symbol=self.symbol, opt_state=opt_state,
                     extra_meta={"epoch": epoch + 1,
                                 "num_update": num_update, **_guard_meta()})
+
+            if mfu_acct is not None and nbatch:
+                spans_e = tl.spans[epoch_span_base:] if tl is not None else []
+                data_wait = sum(d for s in spans_e
+                                for n, _, d in s.phases() if n == "data_wait")
+                mfu_acct.epoch_report(
+                    epoch, nbatch, time.time() - tic,
+                    compile_seconds=cdiff["compile_seconds"]
+                    - compile_snap["compile_seconds"],
+                    data_wait_seconds=data_wait,
+                    skipped_steps=(self.guard_stats["skipped_steps"]
+                                   - skipped_base)
+                    if guard_cfg is not None else 0,
+                    step_retries=(self.guard_stats["step_retries"]
+                                  - retries_base)
+                    if guard_cfg is not None else 0,
+                    checkpoint_seconds=_ckpt_seconds() - ckpt_base,
+                    logger=logger)
 
             _write_back()
 
@@ -1388,6 +1519,15 @@ class FeedForward(BASE_ESTIMATOR):
                 watchdog.stop()
             if preempt_handler is not None:
                 preempt_mod.PreemptionHandler.uninstall()
+            # a mid-step exception (preemption, retry exhaustion) can leave
+            # an un-ended span in the thread-local slot; later phase()
+            # calls must not attach to it, and score()/eval after this fit
+            # must not inherit the finished timeline
+            telemetry_mod.clear_current_span()
+            self._active_timeline = None
+            if tel_sink is not None:
+                telemetry_mod.hub().remove_sink(tel_sink)
+                tel_sink.close()
         return self
 
     # -- AOT warmup -----------------------------------------------------------
@@ -1646,50 +1786,78 @@ class FeedForward(BASE_ESTIMATOR):
         use_device_metric = eval_metric.device_supported
         maccum = self._DeviceMetricAccum(eval_metric) if use_device_metric \
             else None
+        tl = getattr(self, "_active_timeline", None)
         first_rows = {}  # bucket key -> the shape this bucket compiled for
         eval_iter.reset()
-        for batch in eval_iter:
-            bkey = getattr(batch, "bucket_key", None)
-            names = getattr(batch, "data_names", data_names)
-            batch_arrays = {name: arr.data for name, arr in zip(names, batch.data)}
-            # tail batches SHORTER than the bucket's compiled shape pad up
-            # (repeat last row) instead of compiling a one-off program; the
-            # extra rows join the pad slice below. Iterators that pad
-            # in-place (NDArrayIter wrap-around) report pad>0 and are
-            # already full-shape.
-            rows = int(next(iter(batch_arrays.values())).shape[0])
-            target = first_rows.setdefault(bkey, rows)
-            extra = target - rows
-            if extra > 0:
-                batch_arrays = _pad_rows_np(batch_arrays, extra)
-            batch_arrays = self._batch_to_ctx(self._fill_missing_args(
-                params, batch_arrays, symbol=self._symbol_for_bucket(bkey)))
-            pad = batch.pad + max(extra, 0)
-            if use_device_metric and pad == 0:
-                # fused forward+metric, no per-batch host pull; padded tail
-                # batches (at most one per epoch) take the host path below
-                estep = self._get_eval_metric_step(bkey, eval_metric)
-                maccum.state = estep(params, aux, batch_arrays,
-                                     self._batch_to_ctx(
-                                         [l.data for l in batch.label]),
-                                     maccum.state)
-                maccum.after_batch(batch.label)
-                continue
-            pred = self._get_pred_step(bkey)
-            outs = pred(params, aux, batch_arrays)
-            nv = rows - batch.pad  # valid rows of the pre-padding batch
-            outs = [NDArray(o[:nv] if nv != o.shape[0] else o) for o in outs]
-            labels = [NDArray(l.data[:nv] if nv != l.shape[0] else l.data)
-                      for l in batch.label]
-            eval_metric.update(labels, outs)
+        for i, batch in enumerate(eval_iter):
+            span = tl.begin_step(0, i, kind="eval_step") \
+                if tl is not None else None
+            try:
+                bkey = getattr(batch, "bucket_key", None)
+                names = getattr(batch, "data_names", data_names)
+                batch_arrays = {name: arr.data
+                                for name, arr in zip(names, batch.data)}
+                # tail batches SHORTER than the bucket's compiled shape pad
+                # up (repeat last row) instead of compiling a one-off
+                # program; the extra rows join the pad slice below.
+                # Iterators that pad in-place (NDArrayIter wrap-around)
+                # report pad>0 and are already full-shape.
+                rows = int(next(iter(batch_arrays.values())).shape[0])
+                target = first_rows.setdefault(bkey, rows)
+                extra = target - rows
+                if extra > 0:
+                    batch_arrays = _pad_rows_np(batch_arrays, extra)
+                batch_arrays = self._batch_to_ctx(self._fill_missing_args(
+                    params, batch_arrays,
+                    symbol=self._symbol_for_bucket(bkey)))
+                pad = batch.pad + max(extra, 0)
+                if span is not None:
+                    span.mark("dispatch")
+                if use_device_metric and pad == 0:
+                    # fused forward+metric, no per-batch host pull; padded
+                    # tail batches (at most one per epoch) take the host
+                    # path below
+                    estep = self._get_eval_metric_step(bkey, eval_metric)
+                    maccum.state = estep(params, aux, batch_arrays,
+                                         self._batch_to_ctx(
+                                             [l.data for l in batch.label]),
+                                         maccum.state)
+                    if span is not None:
+                        span.mark("device")
+                        jax.block_until_ready(maccum.state)
+                    maccum.after_batch(batch.label)
+                    continue
+                pred = self._get_pred_step(bkey)
+                outs = pred(params, aux, batch_arrays)
+                if span is not None:
+                    span.mark("device")
+                    jax.block_until_ready(outs)
+                    span.mark("host")
+                nv = rows - batch.pad  # valid rows of the pre-padding batch
+                outs = [NDArray(o[:nv] if nv != o.shape[0] else o)
+                        for o in outs]
+                labels = [NDArray(l.data[:nv] if nv != l.shape[0]
+                                  else l.data) for l in batch.label]
+                eval_metric.update(labels, outs)
+            finally:
+                if span is not None:
+                    span.end()
         if use_device_metric:
             maccum.finish()
 
     # -- inference ------------------------------------------------------------
-    def predict(self, X, batch_size=128):
+    def predict(self, X, batch_size=128, telemetry=None):
         """Run forward over X, concatenating outputs (reference: model.py:640).
 
-        Returns a single numpy array for single-output nets, else a list."""
+        Returns a single numpy array for single-output nets, else a list.
+        ``telemetry`` (None/True/TelemetryConfig, env gate
+        ``MXNET_TPU_TELEMETRY``): record a ``predict_step`` span per batch
+        on a fresh StepTimeline at ``self.telemetry``."""
+        tcfg = telemetry_mod.TelemetryConfig.resolve(telemetry)
+        tl = None
+        if tcfg is not None and tcfg.timeline:
+            tl = telemetry_mod.StepTimeline()
+            self.telemetry = tl
         data_iter = _init_iter(X, None, batch_size, is_train=False)
         data_names = [x[0] for x in data_iter.provide_data]
         if self.arg_params is None:
@@ -1699,7 +1867,10 @@ class FeedForward(BASE_ESTIMATOR):
         chunks = None
         first_rows = {}
         data_iter.reset()
-        for batch in data_iter:
+        try:
+          for i, batch in enumerate(data_iter):
+            span = tl.begin_step(0, i, kind="predict_step") \
+                if tl is not None else None
             bkey = getattr(batch, "bucket_key", None)
             pred = self._get_pred_step(bkey)
             names = getattr(batch, "data_names", data_names)
@@ -1711,13 +1882,24 @@ class FeedForward(BASE_ESTIMATOR):
                 batch_arrays = _pad_rows_np(batch_arrays, target - rows)
             batch_arrays = self._batch_to_ctx(self._fill_missing_args(
                 params, batch_arrays, symbol=self._symbol_for_bucket(bkey)))
+            if span is not None:
+                span.mark("dispatch")
             outs = pred(params, aux, batch_arrays)
+            if span is not None:
+                span.mark("device")
+                jax.block_until_ready(outs)
+                span.mark("host")
             nv = rows - batch.pad
             outs = [np.asarray(o[:nv] if nv != o.shape[0] else o) for o in outs]
             if chunks is None:
                 chunks = [[] for _ in outs]
             for lst, o in zip(chunks, outs):
                 lst.append(o)
+            if span is not None:
+                span.end()
+        finally:
+            if tl is not None:  # exception mid-batch: drop the open span
+                telemetry_mod.clear_current_span()
         results = [np.concatenate(lst, axis=0) for lst in chunks]
         return results[0] if len(results) == 1 else results
 

@@ -332,6 +332,13 @@ class Module:
                                       eval_metric=eval_metric)
                     for cb in _as_list(batch_end_callback):
                         cb(p)
+            # stop the epoch clock only once the executor's buffers are
+            # ready (a returned dispatch is not a finished step — the
+            # un-barriered-timing footgun, mxlint MX306)
+            import jax as _jax
+
+            _jax.block_until_ready([a._data for a in
+                                    self._exec.arg_dict.values()])
             name, value = eval_metric.get()
             self._logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
             self._logger.info("Epoch[%d] Time cost=%.3f", epoch,

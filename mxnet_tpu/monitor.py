@@ -120,7 +120,24 @@ class Monitor:
         if self.track_comm:
             res.extend(self.collect_comm())
         self.queue = res
+        self._publish(res)
         return res
+
+    def _publish(self, rows):
+        """Mirror the collected stat rows into the telemetry hub (gauges
+        labeled by stat name + one ``monitor`` event per collection), so
+        Monitor output reaches the same exporters as everything else."""
+        from . import telemetry
+
+        published = 0
+        for _, name, stat in rows:
+            try:
+                value = float(stat)
+            except (TypeError, ValueError):
+                continue  # non-scalar stat_func output stays queue-only
+            telemetry.gauge("monitor_stat", value, stat=name)
+            published += 1
+        telemetry.emit("monitor", rows=published, step=self.step)
 
     def collect_comm(self):
         """Comm-registry deltas since the last collection, as stat rows:

@@ -92,6 +92,11 @@ def retry_call(fn, policy: RetryPolicy, what="op", sleep=time.sleep,
                 raise
             if on_retry is not None:
                 on_retry(attempt, e)
+            from .. import telemetry
+
+            telemetry.counter("resilience_kv_retries_total")
+            telemetry.emit("retry", op=what, attempt=attempt,
+                           error=type(e).__name__)
             sleep(delay)
     try:
         return fn()  # final attempt carries the real failure out
@@ -144,6 +149,11 @@ class CircuitBreaker:
                     "circuit breaker: OPEN after %d consecutive failures "
                     "(retry in %.1fs; degrading to local aggregation)",
                     self._failures, self.reset_after)
+                from .. import telemetry
+
+                telemetry.counter("resilience_circuit_open_total")
+                telemetry.emit("circuit_open", op="kvstore",
+                               failures=self._failures)
             self.state = self.OPEN
             self._opened_at = self._clock()
 

@@ -1,0 +1,112 @@
+"""CLI over exported telemetry JSONL logs.
+
+    python -m mxnet_tpu.telemetry tail run.jsonl [-n 20] [--kind span]
+    python -m mxnet_tpu.telemetry summarize run.jsonl
+
+``tail`` prints the last N events, one formatted line each; ``summarize``
+digests the file: events per kind, span/phase time totals, badput buckets,
+and the MFU/goodput lines of each epoch_summary event.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+
+from .exporters import read_jsonl
+
+
+def _fmt_event(e):
+    kind = e.get("kind", "?")
+    ts = e.get("ts", 0.0)
+    skip = {"kind", "ts", "v", "phases", "subs", "events"}
+    fields = " ".join(f"{k}={e[k]}" for k in sorted(e) if k not in skip)
+    if kind == "span":
+        phases = " ".join(f"{p['name']}={p['dur_ms']:.2f}ms"
+                          for p in e.get("phases", ()))
+        fields += (" | " + phases) if phases else ""
+    return f"[{ts:.6f}] {kind:<14s} {fields}"
+
+
+def cmd_tail(args):
+    events = read_jsonl(args.path)
+    if args.kind:
+        events = [e for e in events if e.get("kind") == args.kind]
+    for e in events[-args.n:]:
+        print(_fmt_event(e))
+    return 0
+
+
+def cmd_summarize(args):
+    events = read_jsonl(args.path)
+    if not events:
+        print(f"{args.path}: no events")
+        return 1
+    by_kind = collections.Counter(e.get("kind", "?") for e in events)
+    print(f"{args.path}: {len(events)} events "
+          f"(schema v{events[0].get('v', '?')})")
+    for kind, n in by_kind.most_common():
+        print(f"  {kind:<16s} {n}")
+
+    spans = [e for e in events if e.get("kind") == "span"]
+    if spans:
+        total = sum(s.get("dur_ms", 0.0) for s in spans)
+        phase_ms = collections.Counter()
+        for s in spans:
+            for p in s.get("phases", ()):
+                phase_ms[p["name"]] += p.get("dur_ms", 0.0)
+        print(f"spans: {len(spans)}, {total:.1f} ms total, "
+              f"{total / len(spans):.2f} ms mean")
+        for name, ms in phase_ms.most_common():
+            print(f"  phase {name:<12s} {ms:10.1f} ms "
+                  f"({100.0 * ms / total if total else 0:.1f}%)")
+
+    badput = collections.Counter()
+    for e in events:
+        if e.get("kind") == "badput":
+            badput[e.get("reason", "?")] += float(e.get("seconds", 0.0))
+    if badput:
+        print("badput:")
+        for reason, s in badput.most_common():
+            print(f"  {reason:<12s} {s:.2f} s")
+
+    for e in events:
+        if e.get("kind") == "epoch_summary":
+            mfu = e.get("mfu_pct")
+            print(f"epoch {e.get('epoch')}: {e.get('steps')} steps in "
+                  f"{float(e.get('seconds', 0.0)):.2f}s, "
+                  f"goodput {float(e.get('goodput_pct', 0.0)):.1f}%, "
+                  + (f"MFU {mfu:.1f}%" if isinstance(mfu, (int, float))
+                     else "MFU n/a"))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m mxnet_tpu.telemetry",
+                                 description=__doc__,
+                                 formatter_class=argparse
+                                 .RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    t = sub.add_parser("tail", help="print the last N events")
+    t.add_argument("path")
+    t.add_argument("-n", type=int, default=20)
+    t.add_argument("--kind", default=None)
+    t.set_defaults(fn=cmd_tail)
+    s = sub.add_parser("summarize", help="digest an event log")
+    s.add_argument("path")
+    s.set_defaults(fn=cmd_summarize)
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except json.JSONDecodeError as e:
+        print(f"error: {args.path} is not valid JSONL: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,6 +119,20 @@ def save_sharded(directory, step, params, aux=None, symbol=None,
     ``.tmp.<step>`` dir; the final ``os.rename`` is the commit point. A
     crash anywhere before it leaves earlier steps untouched.
     """
+    from .. import telemetry
+
+    t0 = telemetry.hub().now()
+    with telemetry.phase("checkpoint_save"):
+        out = _save_sharded(directory, step, params, aux=aux, symbol=symbol,
+                            extra_meta=extra_meta, opt_state=opt_state)
+    telemetry.counter("checkpoint_saves_total")
+    telemetry.emit("checkpoint", step=int(step),
+                   seconds=telemetry.hub().now() - t0)
+    return out
+
+
+def _save_sharded(directory, step, params, aux=None, symbol=None,
+                  extra_meta=None, opt_state=None):
     directory = os.path.abspath(os.fspath(directory))
     os.makedirs(directory, exist_ok=True)
     step = int(step)

@@ -388,7 +388,10 @@ class TrackedJit:
         with reg.attribute(self.label):
             t0 = time.perf_counter()
             out = self._jitted(*args, **kwargs)
-            dt = time.perf_counter() - t0
+            # intentionally un-barriered: this measures the HOST-side cost
+            # of the dispatch (trace + compile on a miss), which is
+            # synchronous — execution time is the profiler's job
+            dt = time.perf_counter() - t0  # mxlint: disable=MX306
         after = self._cache_size()
         if before is not None and after is not None:
             missed = after > before

@@ -41,14 +41,46 @@ def profile_scope(name: str):
 
 class Timer:
     """Wall-clock timer that blocks on device work for honest measurements
-    (≙ dmlc/timer.h + WaitForAll in the reference's engine benchmarks)."""
+    (≙ dmlc/timer.h + WaitForAll in the reference's engine benchmarks).
+
+    Register the computation's outputs with :meth:`block` inside the
+    ``with`` body::
+
+        with Timer() as t:
+            out = step(x)
+            t.block(out)          # any pytree of jax.Arrays
+        print(t.elapsed)
+
+    On exit the timer calls ``jax.block_until_ready`` on everything
+    registered BEFORE reading the clock, so a dispatched-but-unfinished
+    step is fully counted. This replaced ``jax.effects_barrier()``, which
+    only orders *effects* (callbacks, io) — on jax pins in our supported
+    range it returns without waiting for committed pure computation, so an
+    async-dispatched step could be timed at enqueue cost instead of run
+    cost (regression-tested in tests/test_profiler.py). When nothing was
+    registered the exit falls back to ``effects_barrier`` — correct only
+    for effectful work; register outputs whenever any exist."""
+
+    def __init__(self):
+        self._outputs = []
+
+    def block(self, *outputs):
+        """Register output pytrees to be blocked on at exit. Returns the
+        single output (or the tuple) for call-through convenience."""
+        self._outputs.extend(outputs)
+        return outputs[0] if len(outputs) == 1 else outputs
 
     def __enter__(self):
+        self._outputs = []
         self.start = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        jax.effects_barrier()
+        if exc and exc[0] is None:
+            if self._outputs:
+                jax.block_until_ready(self._outputs)
+            else:
+                jax.effects_barrier()
         self.elapsed = time.perf_counter() - self.start
         return False
 

@@ -40,6 +40,31 @@ def test_bench_comm_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_COMM_*.json
 
 
+def test_bench_telemetry_smoke_json_contract():
+    """--telemetry-bench --smoke is the CI guard on the telemetry bench
+    entry: one JSON line with the contract keys, hub op costs measured,
+    and the acceptance bound — hub overhead under 2% of the baseline step
+    on the 8-virtual-device smoke run."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--telemetry-bench", "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "emit_ns",
+                "observe_ns", "counter_ns", "step_ms_baseline",
+                "step_ms_telemetry", "timeline_overhead_pct"):
+        assert key in blob, blob
+    assert blob["metric"] == "telemetry_hub_overhead_pct_of_step"
+    assert blob["emit_ns"] > 0 and blob["step_ms_baseline"] > 0
+    # the acceptance bound: hub instrumentation costs <2% of a step
+    assert 0 < blob["value"] < 2.0, blob
+    assert blob["smoke"] is True  # smoke runs never write BENCH_TELEMETRY_*
+
+
 @pytest.mark.slow
 def test_bench_pipeline_mode_json_contract(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -295,6 +295,99 @@ def test_fixture_bounded_retry_loops_are_clean():
     assert _ids(lint_source(src3, "fx.py")) == []
 
 
+# -- MX306 un-barriered timing fixtures (ISSUE 5 satellite) -------------------
+
+def test_fixture_mx306_unbarriered_delta():
+    src = (
+        "import time\n"
+        "def bench(step, x):\n"
+        "    t0 = time.time()\n"
+        "    out = step(x)\n"
+        "    return time.time() - t0\n"
+    )
+    findings = lint_source(src, "fx.py")
+    assert _ids(findings) == ["MX306"]
+    assert findings[0].line == 5
+    # perf_counter, delta via a second stored read
+    src2 = (
+        "from time import perf_counter\n"
+        "def bench(step, x):\n"
+        "    t0 = perf_counter()\n"
+        "    out = step(x)\n"
+        "    t1 = perf_counter()\n"
+        "    return t1 - t0\n"
+    )
+    assert _ids(lint_source(src2, "fx.py")) == ["MX306"]
+
+
+def test_fixture_mx306_barriered_deltas_are_clean():
+    # block_until_ready between start and read
+    src = (
+        "import time\n"
+        "import jax\n"
+        "def bench(step, x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    out = step(x)\n"
+        "    jax.block_until_ready(out)\n"
+        "    return time.perf_counter() - t0\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+    # monotonic deadlines/backoff bookkeeping are not measurements
+    src2 = (
+        "import time\n"
+        "def poll(op):\n"
+        "    start = time.monotonic()\n"
+        "    op()\n"
+        "    return time.monotonic() - start\n"
+    )
+    assert _ids(lint_source(src2, "fx.py")) == []
+    # no work between the reads: nothing is being mis-timed
+    src3 = (
+        "import time\n"
+        "def stamp():\n"
+        "    t0 = time.time()\n"
+        "    return time.time() - t0\n"
+    )
+    assert _ids(lint_source(src3, "fx.py")) == []
+    # blocking .result() (engine futures, precompile) counts as a barrier
+    src4 = (
+        "import time\n"
+        "def bench(pool, job):\n"
+        "    t0 = time.time()\n"
+        "    fut = pool.submit(job)\n"
+        "    fut.result()\n"
+        "    return time.time() - t0\n"
+    )
+    assert _ids(lint_source(src4, "fx.py")) == []
+
+
+def test_fixture_mx306_pragma_and_exempt_paths():
+    src = (
+        "import time\n"
+        "def bench(step, x):\n"
+        "    t0 = time.time()\n"
+        "    out = step(x)\n"
+        "    return time.time() - t0  # mxlint: disable=MX306\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+    src2 = src.replace("  # mxlint: disable=MX306", "")
+    # the sanctioned timing homes are exempt wholesale
+    assert _ids(lint_source(
+        src2, "mxnet_tpu/telemetry/timeline.py")) == []
+    assert _ids(lint_source(src2, "mxnet_tpu/utils/profiler.py")) == []
+
+
+def test_tree_has_no_mx306_findings():
+    """ISSUE 5 satellite: the tree self-lints clean of the un-barriered-
+    timing footgun (every wall-clock measurement either blocks first or is
+    explicitly pragma'd with its justification)."""
+    from mxnet_tpu.analysis import lint_paths
+
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX306"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 # -- Pass 2: graph verifier fixtures ------------------------------------------
 
 def test_fixture_duplicate_argument():

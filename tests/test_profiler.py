@@ -59,3 +59,47 @@ def test_profile_step_smoke(tmp_path):
                                            log_dir=str(tmp_path / "tr"))
     assert os.path.isdir(log_dir)
     assert isinstance(stats, list)  # may be empty on host-only traces
+
+
+def test_timer_counts_dispatched_but_unfinished_work():
+    """Regression (ISSUE 5 satellite): Timer must block on the actual
+    outputs, not on jax.effects_barrier() — effects_barrier orders effects
+    only and does not wait for committed pure computation on all jax pins,
+    so an async-dispatched step could previously be timed at enqueue cost.
+    A dispatched-but-unfinished computation must be FULLY counted."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def heavy(a):
+        def body(_, x):
+            return jnp.tanh(x @ a)
+
+        return jax.lax.fori_loop(0, 40, body, a)
+
+    a = jnp.asarray(np.random.RandomState(0).randn(512, 512)
+                    .astype(np.float32))
+    jax.block_until_ready(heavy(a))  # compile outside any timed window
+
+    # ground truth: synchronous run time
+    t0 = time.perf_counter()
+    jax.block_until_ready(heavy(a))
+    sync_s = time.perf_counter() - t0
+
+    with profiler.Timer() as t:
+        t.block(heavy(a))  # async dispatch; Timer must wait for the result
+    assert t.elapsed >= 0.5 * sync_s, \
+        f"Timer undercounted: {t.elapsed:.4f}s vs sync {sync_s:.4f}s"
+
+
+def test_timer_block_returns_outputs_and_nests_pytrees():
+    import jax.numpy as jnp
+
+    with profiler.Timer() as t:
+        out = t.block(jnp.ones(4) * 2)
+        pair = t.block(jnp.zeros(2), {"a": jnp.ones(3)})
+    assert float(out.sum()) == 8.0
+    assert isinstance(pair, tuple) and len(pair) == 2
+    assert t.elapsed >= 0.0
