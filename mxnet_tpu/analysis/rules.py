@@ -159,6 +159,18 @@ register_rule(
     "bucketing, and comm_stats() byte accounting")
 
 register_rule(
+    "MX307", "warning",
+    "StepTimeline span or phase opened without a guaranteed close: a "
+    "`begin_step(...)` result that is never `.end()`ed (or a "
+    "`telemetry.phase()/timed()` context manager called but never "
+    "entered) leaks an open span — later phases attach to a dead step "
+    "and the cross-rank trace merge sees overlapping/unterminated spans",
+    "close every span: `with tl.begin_step(...) as span:` (spans are "
+    "context managers), or call `span.end()` on every exit path; use "
+    "`with telemetry.phase(...)/timed(...):` — a bare call records "
+    "nothing")
+
+register_rule(
     "MX306", "warning",
     "un-barriered wall-clock delta around device dispatch: a "
     "time.time()/perf_counter() start/stop pair with work between and no "

@@ -539,6 +539,126 @@ def _scan_unbarriered_timing(tree, path, imports, findings):
                     path=path, line=lineno, col=col))
 
 
+# -- MX307: leaked StepTimeline spans / phases --------------------------------
+# A span that is opened but not closed on every path poisons the trace:
+# later phase() calls attach to the dead step and the cross-rank merge
+# sees unterminated/overlapping spans. The scan is function-local and
+# zero-FP-biased: it flags (a) a `<x>.begin_step(...)` result bound to a
+# name on which `.end()` is never called anywhere in the same function
+# (spans used as `with` context managers are fine — __exit__ ends them),
+# (b) a bare-expression `begin_step(...)` whose span can never be ended,
+# and (c) a bare-expression `telemetry.phase(...)`/`timed(...)` call —
+# those return context managers; calling without `with` records nothing
+# and is always a bug. telemetry/ itself (the primitives' home) is exempt.
+
+_SPAN_OPENERS = ("begin_step",)
+_CM_TIMERS = ("phase", "timed")
+
+
+def _call_attr_name(node):
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    return f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", None)
+
+
+class _FnSpanScan(ast.NodeVisitor):
+    """One function body: span-opening assignments, .end() calls, with-
+    managed opens, and bare context-manager-returning calls. Nested defs
+    are their own scope (the driver visits them separately)."""
+
+    def __init__(self):
+        self.opened = {}       # name -> lineno of `x = ....begin_step(...)`
+        self.ended = set()     # names with `.end(` called on them
+        self.bare = []         # (lineno, col, what) immediate findings
+
+    def visit_FunctionDef(self, node):  # separate scope
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _record_open(self, target, value):
+        """Bind span-opening call results (looking through ternaries:
+        `span = tl.begin_step(...) if tl else None`)."""
+        for v in ([value.body, value.orelse]
+                  if isinstance(value, ast.IfExp) else [value]):
+            if _call_attr_name(v) in _SPAN_OPENERS and \
+                    isinstance(target, ast.Name):
+                self.opened[target.id] = (v.lineno, v.col_offset)
+
+    def visit_Assign(self, node):
+        if len(node.targets) == 1:
+            self._record_open(node.targets[0], node.value)
+        self.generic_visit(node)
+
+    def visit_With(self, node):
+        # `with tl.begin_step(...) [as span]:` — __exit__ closes it
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
+    def visit_Expr(self, node):
+        name = _call_attr_name(node.value)
+        if name in _SPAN_OPENERS:
+            self.bare.append((node.lineno, node.col_offset,
+                              "span from bare `begin_step(...)` call is "
+                              "discarded and can never be ended"))
+        elif name in _CM_TIMERS:
+            self.bare.append((node.lineno, node.col_offset,
+                              f"`{name}(...)` returns a context manager; "
+                              "calling it without `with` records nothing"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "end" and \
+                isinstance(f.value, ast.Name):
+            self.ended.add(f.value.id)
+        self.generic_visit(node)
+
+
+def _with_bound_names(fn):
+    """Names bound by `with ... as <name>` anywhere in the function —
+    `with tl.begin_step(...) as span:` closes span via __exit__, and an
+    extra span.end() is not required."""
+    names = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.With, ast.AsyncWith)):
+            for item in sub.items:
+                if isinstance(item.optional_vars, ast.Name):
+                    names.add(item.optional_vars.id)
+    return names
+
+
+def _scan_leaked_spans(tree, path, findings):
+    if _exempt_timing_path(path):
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scan = _FnSpanScan()
+        for stmt in fn.body:
+            scan.visit(stmt)
+        for lineno, col, what in scan.bare:
+            findings.append(Finding(get_rule("MX307"), what,
+                                    path=path, line=lineno, col=col))
+        with_names = None
+        for name, (lineno, col) in scan.opened.items():
+            if name in scan.ended:
+                continue
+            if with_names is None:
+                with_names = _with_bound_names(fn)
+            if name in with_names:
+                continue
+            findings.append(Finding(
+                get_rule("MX307"),
+                f"span `{name}` opened with begin_step() but `.end()` is "
+                "never called in this function (leaked spans poison the "
+                "cross-rank merge)",
+                path=path, line=lineno, col=col))
+
+
 # calls whose presence inside a retry loop counts as bounding it: anything
 # sleep/backoff/wait-shaped (time.sleep, policy backoff, cv.wait_for, ...)
 _BOUNDING_CALL_PARTS = ("sleep", "backoff", "wait", "delay", "retry_call",
@@ -641,6 +761,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     scan.visit(tree)
     _scan_robustness(tree, path, scan.findings)
     _scan_unbarriered_timing(tree, path, scan.imports, scan.findings)
+    _scan_leaked_spans(tree, path, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)
     roots += [d for d in scan.defs if d.name in scan.traced_names]

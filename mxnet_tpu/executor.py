@@ -434,6 +434,10 @@ class Executor:
         from . import telemetry
 
         telemetry.counter("executor_forward_total")
+        with telemetry.phase("executor_forward"):
+            return self._forward_impl(is_train, **kwargs)
+
+    def _forward_impl(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError(f"forward: unknown argument {k!r}")
@@ -526,6 +530,10 @@ class Executor:
         from . import telemetry
 
         telemetry.counter("executor_backward_total")
+        with telemetry.phase("executor_backward"):
+            return self._backward_impl(out_grads)
+
+    def _backward_impl(self, out_grads=None):
         arg_vals, aux_vals, rng = self._last
         diff_names = self._diff_names()
         if not diff_names:

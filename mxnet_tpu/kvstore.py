@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
 import jax
 import numpy as np
@@ -395,6 +396,9 @@ class _GroupServer:
         self.duplicate_count = 0
         self._barrier_count = 0
         self._barrier_round = 0
+        # per-pushing-thread collective-wait seconds (one thread per
+        # worker in the group harness — must not share across pushers)
+        self._wait_tls = threading.local()
         # compressed-push accounting: what arrived vs what fp32 would cost
         self.wire_bytes_received = 0
         self.raw_bytes_received = 0
@@ -422,7 +426,43 @@ class _GroupServer:
             if key not in self.store:
                 self.store[key] = np.array(value, np.float32)
 
-    def push(self, key, value: np.ndarray, worker=None, seq=None):
+    def push(self, key, value: np.ndarray, worker=None, seq=None,
+             trace=None):
+        """BSP push. ``trace`` (telemetry.trace_ctx()) attaches the
+        server-side handling — and any replay-dedup hit — to the worker
+        step span that caused it: the merge CLI parents the emitted
+        ``server_span``/``server_dedup`` events under ``trace.span_id``.
+        Emission is gated on an OPEN worker step span: per-key pushes
+        outside any step (Module.update's legacy loop, init-time traffic)
+        would otherwise flood the event ring with unparentable noise."""
+        if trace is None or trace.get("span_id") is None:
+            self._push_locked(key, value, worker, seq)
+            return
+        from . import telemetry
+
+        t0 = telemetry.hub().now()
+        dedup = self._push_locked(key, value, worker, seq)
+        # wait_s: cv.wait_for time inside _push_locked is collective wait
+        # on the other ranks, not handling — folding it into dur_ms would
+        # paint the slow rank's skew as server time on every fast rank's
+        # trace (emit_server_span reports it as barrier_wait_ms instead)
+        telemetry.emit_server_span(
+            "push", trace, t0, dedup=dedup, key=key,
+            origin_rank=trace.get("rank", worker),
+            wait_s=getattr(self._wait_tls, "s", 0.0))
+
+    def _push_locked(self, key, value, worker, seq):
+        """The BSP accumulate/release protocol; True = duplicate resend
+        (absorbed, not double-counted). Time spent blocked in cv.wait_for
+        (waiting on the rest of the round, not handling this push) lands
+        in the calling thread's ``self._wait_tls.s``."""
+        self._wait_tls.s = 0.0
+
+        def _wait(predicate):
+            t = time.monotonic()
+            self.cv.wait_for(predicate)
+            self._wait_tls.s += time.monotonic() - t
+
         with self.cv:
             value = self._decode_value(key, value)
             my_round = self._round.get(key, 0)
@@ -434,17 +474,15 @@ class _GroupServer:
                     # completed round): wait for ITS round, not the open one
                     self.duplicate_count += 1
                     applied_round = prev[1]
-                    self.cv.wait_for(
-                        lambda: self._round.get(key, 0) > applied_round)
-                    return
+                    _wait(lambda: self._round.get(key, 0) > applied_round)
+                    return True
                 contrib = self._contrib.setdefault(key, set())
                 if worker in contrib:
                     # same-round duplicate without a usable seq: already
                     # counted; park until the open round releases
                     self.duplicate_count += 1
-                    self.cv.wait_for(
-                        lambda: self._round.get(key, 0) > my_round)
-                    return
+                    _wait(lambda: self._round.get(key, 0) > my_round)
+                    return True
                 contrib.add(worker)
                 self._applied[(key, worker)] = (seq, my_round)
             if key not in self._accum or self._count.get(key, 0) == 0:
@@ -464,11 +502,20 @@ class _GroupServer:
                 self._round[key] = my_round + 1
                 self.cv.notify_all()
             else:
-                self.cv.wait_for(lambda: self._round.get(key, 0) > my_round)
+                _wait(lambda: self._round.get(key, 0) > my_round)
+            return False
 
-    def pull(self, key) -> np.ndarray:
+    def pull(self, key, trace=None) -> np.ndarray:
+        if trace is None or trace.get("span_id") is None:
+            with self.lock:
+                return self.store[key].copy()
+        from . import telemetry
+
+        t0 = telemetry.hub().now()
         with self.lock:
-            return self.store[key].copy()
+            value = self.store[key].copy()
+        telemetry.emit_server_span("pull", trace, t0, key=key)
+        return value
 
     def barrier(self):
         with self.cv:
@@ -493,6 +540,20 @@ class _GroupWorkerKVStore(KVStore):
         self._push_seq: dict = {}  # key -> next sequence number
         self._retry_policy = None  # built lazily (rank-seeded jitter)
         self._codec = None         # HostCodec, armed by compression
+        self._beacon_sent = False  # one clock beacon per worker handle
+
+    def _maybe_beacon(self):
+        """Exchange one clock-offset beacon with the server (in-process
+        the clocks coincide — offset ~0 — but the merge protocol is the
+        same one dist_async exercises over a real wire)."""
+        if self._beacon_sent:
+            return
+        self._beacon_sent = True
+        from . import telemetry
+
+        h = telemetry.hub()
+        t_send = h.now()
+        telemetry.record_clock_beacon("server", t_send, h.now(), h.now())
 
     def set_gradient_compression(self, compression):
         spec = super().set_gradient_compression(compression)
@@ -535,6 +596,11 @@ class _GroupWorkerKVStore(KVStore):
         from .resilience.retry import RetryPolicy, retry_call
 
         telemetry.counter("kvstore_push_pull_total")
+        self._maybe_beacon()
+        # trace identity rides the push envelope: server handling and
+        # replay-dedup hits become child spans of this worker's open step
+        trace = telemetry.trace_ctx()
+        trace["rank"] = self._rank
         if self._retry_policy is None:
             self._retry_policy = RetryPolicy(seed=self._rank)
         for k, vlist in self._as_pairs(key, value):
@@ -559,7 +625,8 @@ class _GroupWorkerKVStore(KVStore):
             def attempt(k=k, value_np=value_np, seq=seq):
                 # request lost before the server saw it
                 chaos_mod.maybe_raise("group.push.send")
-                self._server.push(k, value_np, worker=self._rank, seq=seq)
+                self._server.push(k, value_np, worker=self._rank, seq=seq,
+                                  trace=trace)
                 # ack lost after the server applied it: the retry resends
                 # the same (worker, seq) and the server deduplicates
                 chaos_mod.maybe_raise("group.push.ack")
@@ -568,8 +635,12 @@ class _GroupWorkerKVStore(KVStore):
 
     def pull(self, key, out, priority=0):
         del priority
+        from . import telemetry
+
+        trace = telemetry.trace_ctx()
+        trace["rank"] = self._rank
         for k, outs in self._as_pairs(key, out):
-            value = self._server.pull(k)
+            value = self._server.pull(k, trace=trace)
             if isinstance(outs, NDArray):
                 outs = [outs]
             for o in outs:
@@ -585,21 +656,34 @@ class _GroupWorkerKVStore(KVStore):
 
 
 def create(kv_type="local") -> KVStore:
-    """Create a KVStore (reference: kvstore.cc:17-49 type-string factory)."""
+    """Create a KVStore (reference: kvstore.cc:17-49 type-string factory).
+
+    The created store is the process's rank/world authority: telemetry
+    adopts (rank, num_workers) from it so every hub metric family and
+    JSONL event is labeled with the right identity."""
     kv_type = kv_type.lower()
     if kv_type in ("local", "local_update_cpu", "local_allreduce_cpu"):
-        return KVStore(kv_type)
-    if kv_type in ("device", "local_allreduce_device"):
+        store = KVStore(kv_type)
+    elif kv_type in ("device", "local_allreduce_device"):
         # reference maps local_allreduce_device to the device store
         # (kvstore.cc:17-49)
-        return _DeviceKVStore(kv_type)
-    if kv_type in ("dist", "dist_sync"):
-        return _DistKVStore("dist_sync")
-    if kv_type == "dist_async":
+        store = _DeviceKVStore(kv_type)
+    elif kv_type in ("dist", "dist_sync"):
+        store = _DistKVStore("dist_sync")
+    elif kv_type == "dist_async":
         from .kvstore_async import AsyncKVStore
 
-        return AsyncKVStore()
-    raise MXNetError(f"unknown kvstore type {kv_type!r}")
+        store = AsyncKVStore()
+    else:
+        raise MXNetError(f"unknown kvstore type {kv_type!r}")
+    if store.num_workers > 1 or store.rank:
+        # only a genuinely distributed store is an identity authority: a
+        # later auxiliary create('local') (rank 0 of 1 by construction)
+        # must not clobber the rank a dist store already established
+        from . import telemetry
+
+        telemetry.set_world(store.rank, store.num_workers)
+    return store
 
 
 def create_group(num_workers: int, kv_type="dist_sync", compression=None):

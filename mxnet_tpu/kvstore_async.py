@@ -202,6 +202,10 @@ class _AsyncServer:
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
         self._srv.listen(max(8, num_workers * 2))
+        self._trace_id = None      # fleet trace id (op "trace": first
+                                   # worker publishes, everyone adopts)
+        self._conn_tls = threading.local()  # per-connection-thread flags
+                                   # (each conn has its own _serve thread)
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
@@ -252,6 +256,10 @@ class _AsyncServer:
                 self._applied[rank] = (seq, None)  # claim: caller applies
                 return False
             self.duplicate_count += 1
+            # flag THIS connection's thread: the trace wrapper reads it to
+            # emit server_dedup for the right request (a global counter
+            # delta would misattribute a concurrent worker's dedup)
+            self._conn_tls.dedup = True
             if prev[0] == seq and prev[1] is None:
                 # original still applying on another connection: wait for
                 # its reply rather than re-applying (also released if a
@@ -273,8 +281,41 @@ class _AsyncServer:
                 self._applied[ident[0]] = (ident[1], reply)
                 self.cv.notify_all()
 
+    # data-plane ops whose server-side handling is worth a child span in
+    # the worker's trace (control ops would only add noise)
+    _TRACED_OPS = frozenset({"push", "pull", "push_many", "pull_many",
+                             "push_pull", "push_many_enc", "push_pull_enc"})
+
     def _handle(self, conn, msg):
-        """Serve one request; True means the connection is done."""
+        """Serve one request; True means the connection is done.
+
+        Requests may arrive wrapped in a ``("tr", ctx, inner)`` trace
+        envelope (AsyncKVStore._call): the server adopts the fleet trace
+        id and emits a ``server_span`` — and, when the replay cache
+        answered, a ``server_dedup`` — parented under the worker step span
+        named in ``ctx``, so the cross-rank merge shows exactly which
+        worker step each server-side handling belongs to."""
+        trace = None
+        if msg and msg[0] == "tr":
+            trace, msg = msg[1], msg[2]
+        # only requests caused by an OPEN worker step get server spans:
+        # control ops and between-step traffic would be unparentable noise
+        if trace is None or trace.get("span_id") is None or \
+                msg[0] not in self._TRACED_OPS:
+            return self._handle_op(conn, msg)
+        from . import telemetry
+
+        telemetry.set_trace_id(trace.get("trace_id"), adopt=True)
+        t0 = telemetry.hub().now()
+        self._conn_tls.dedup = False
+        done = self._handle_op(conn, msg)
+        telemetry.emit_server_span(
+            msg[0], trace, t0,
+            dedup=bool(getattr(self._conn_tls, "dedup", False)),
+            origin_rank=trace.get("rank", -1))
+        return done
+
+    def _handle_op(self, conn, msg):
         op = msg[0]
         if op == "init":
             _, key, value = msg
@@ -415,11 +456,37 @@ class _AsyncServer:
                 values = {k: self.store[k].copy() for k in keys}
             _send_msg(conn, ("ok", values))
         elif op == "stats":
+            # the full server-health head: workers mirror these as hub
+            # gauges so server state shows up in worker-side traces
             with self.lock:
                 _send_msg(conn, ("ok", {
                     "update_count": self.update_count,
                     "wire_bytes_received": self.wire_bytes_received,
-                    "raw_bytes_received": self.raw_bytes_received}))
+                    "raw_bytes_received": self.raw_bytes_received,
+                    "duplicate_count": self.duplicate_count,
+                    "num_workers": self.num_workers,
+                    "keys": len(self.store),
+                    "barrier_round": self._barrier_round}))
+        elif op == "trace":
+            # fleet trace identity, first-write-wins: every worker OFFERS
+            # its id and adopts the canonical reply, so the fleet shares
+            # one id regardless of connect order (a rank-0-only publish
+            # would leave early-connecting workers with a split identity)
+            _, tid = msg
+            from . import telemetry
+
+            with self.lock:
+                if tid and self._trace_id is None:
+                    self._trace_id = str(tid)
+                out = self._trace_id
+            if out:
+                telemetry.set_trace_id(out, adopt=True)
+            _send_msg(conn, ("ok", out))
+        elif op == "clock":
+            # offset beacon: the caller records (t_send, this, t_recv)
+            from . import telemetry
+
+            _send_msg(conn, ("ok", telemetry.hub().now()))
         elif op == "set_optimizer":
             _, blob = msg
             from .optimizer import get_updater
@@ -464,6 +531,13 @@ class AsyncKVStore(KVStore):
         super().__init__("dist_async")
         self._rank = int(os.environ.get("MXTPU_WORKER_RANK", "0"))
         self._nproc = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
+        if self._nproc > 1:
+            # adopt identity BEFORE any telemetry fires below: the clock
+            # beacon and trace handshake must carry this worker's rank,
+            # not the process default of 0
+            from . import telemetry
+
+            telemetry.set_world(self._rank, self._nproc)
         host, port = self._server_addr()
         self._host, self._port = host, port
         self._server = None
@@ -478,6 +552,28 @@ class AsyncKVStore(KVStore):
         self._codec = None         # HostCodec for compressed pushes
         self._bucketer = None      # (key tuple, bucketer, layout, hash)
         self._layouts_sent: set = set()  # layout hashes the server holds
+        self._sync_trace_identity()
+
+    def _sync_trace_identity(self):
+        """Join the fleet trace: every worker offers its local trace id to
+        the parameter host (first write wins) and adopts the canonical
+        reply — one fleet identity regardless of connect order; each
+        worker then exchanges one clock-offset beacon (the merge CLI
+        aligns this rank's timestamps onto the server clock with it).
+        Best-effort — tracing must never block training."""
+        from . import telemetry
+
+        try:
+            tid = self._call("trace", telemetry.trace_id())
+            if tid:
+                telemetry.set_trace_id(tid)
+            h = telemetry.hub()
+            t_send = h.now()
+            t_peer = self._call("clock")
+            telemetry.record_clock_beacon("server", t_send, float(t_peer),
+                                          h.now())
+        except MXNetError:
+            pass
 
     def _server_addr(self):
         coord = os.environ.get("MXTPU_COORDINATOR")
@@ -537,6 +633,16 @@ class AsyncKVStore(KVStore):
             if mutating:
                 msg = msg + (self._rank, self._next_seq)
                 self._next_seq += 1
+            # trace envelope: the server parents its handling span (and
+            # any replay-dedup hit) under this worker's open step span.
+            # Captured once per logical request — a retry resends the SAME
+            # context, so the resend still attaches to the step that
+            # caused it.
+            from . import telemetry
+
+            ctx = telemetry.trace_ctx()
+            ctx["rank"] = self._rank
+            msg = ("tr", ctx, msg)
 
             def attempt():
                 if self._sock is None:
@@ -731,10 +837,22 @@ class AsyncKVStore(KVStore):
         self._call("barrier", retry=False, timeout=None)
 
     def stats(self) -> dict:
-        """Server-side counters ({'update_count': N} — push requests
-        applied on arrival: one per push_many/push_pull batch, one per key
-        for legacy single-key push), for staleness characterization."""
-        return self._call("stats")
+        """Server-side health counters, fetched over the wire and mirrored
+        as worker-side hub gauges (``kvstore_server_*``) — the parameter
+        host's state shows up in every worker's traces and /metrics scrape
+        instead of being printable only where the server lives.
+        ``update_count`` counts push requests applied on arrival: one per
+        push_many/push_pull batch, one per key for legacy single-key push
+        (staleness characterization)."""
+        from . import telemetry
+
+        s = self._call("stats")
+        h = telemetry.hub()
+        for k, v in s.items():
+            if isinstance(v, (int, float)):
+                h.gauge(f"kvstore_server_{k}", float(v))
+        h.emit("server_stats", **s)
+        return s
 
     def __del__(self):
         try:

@@ -56,10 +56,19 @@ class KVStoreServer:
             self._stopped = True
         elif head == -3:  # kSyncMode
             self.sync_mode = True
-        elif head == -4:  # resilience stats (capability extension)
+        elif head == -4:  # resilience/health stats (capability extension)
+            from . import telemetry
+
             with self.server.lock:
                 return {"rounds": dict(self.server._round),
-                        "duplicates": self.server.duplicate_count}
+                        "duplicates": self.server.duplicate_count,
+                        "wire_bytes_received":
+                            self.server.wire_bytes_received,
+                        "raw_bytes_received":
+                            self.server.raw_bytes_received,
+                        "num_workers": self.server.num_workers,
+                        "keys": len(self.server.store),
+                        "trace_id": telemetry.trace_id()}
         return None
 
     def run(self):

@@ -991,6 +991,13 @@ class FeedForward(BASE_ESTIMATOR):
 
         kv = _create_kvstore(kvstore, len(self.ctx), self.arg_params)
         num_workers = kv.num_workers if kv is not None else 1
+        if kv is not None and (num_workers > 1 or kv.rank):
+            # a distributed kvstore is the rank/world authority: every hub
+            # metric family and JSONL event gets labeled with it (a
+            # thread-local telemetry.rank_scope, e.g. the in-process
+            # multi-worker harness, still overrides per thread; a local
+            # store's hardcoded 0/1 must not clobber a real identity)
+            telemetry_mod.set_world(kv.rank, num_workers)
         async_kv = kv is not None and kv.type == "dist_async"
         # dist_async: no BSP collective — each worker trains against the
         # parameter host at its own pace, so the mesh stays process-local
@@ -1214,6 +1221,9 @@ class FeedForward(BASE_ESTIMATOR):
                 logger.info("preemption: flushed checkpoint step %d "
                             "(epoch %d, %d updates)", epoch, epoch,
                             num_update)
+            # black box alongside the checkpoint: the last K steps +
+            # incidents that led into the preemption
+            telemetry_mod.flight.auto_dump("preempt")
             _write_back()
             raise preempt_mod.TrainingPreempted(
                 f"training preempted by SIGTERM during epoch {epoch} "
@@ -1320,6 +1330,10 @@ class FeedForward(BASE_ESTIMATOR):
                                 break
                             except chaos_mod.TransientStepError:
                                 if retries <= 0:
+                                    # retry budget exhausted: leave a
+                                    # black box before failing the run
+                                    telemetry_mod.flight.auto_dump(
+                                        "guard_trip")
                                     raise
                                 retries -= 1
                                 self.guard_stats["step_retries"] += 1
@@ -1399,6 +1413,11 @@ class FeedForward(BASE_ESTIMATOR):
                             cb(p)
                     if span is not None:
                         span.end()
+                    else:
+                        # timeline off: the always-on flight recorder still
+                        # gets a step mark (identity + timestamp), so a
+                        # crash dump shows the last K steps either way
+                        telemetry_mod.flight.note_step(epoch, nbatch - 1)
             finally:
                 if feed_depth > 0:
                     feed.close()

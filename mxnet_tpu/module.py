@@ -314,6 +314,13 @@ class Module:
             for i, name in enumerate(self._param_names):
                 kvstore.init(i, self._exec.arg_dict[name])
             self._kv_ready = True
+        from . import telemetry as telemetry_mod
+
+        if kvstore is not None and (kvstore.num_workers > 1
+                                    or kvstore.rank):
+            # a distributed kvstore is the rank/world authority (same
+            # contract as FeedForward.fit)
+            telemetry_mod.set_world(kvstore.rank, kvstore.num_workers)
         eval_metric = metric_mod.create(eval_metric)
         for epoch in range(num_epoch):
             tic = time.time()
@@ -326,6 +333,11 @@ class Module:
                 self.update(kvstore=kvstore)
                 self.update_metric(eval_metric, batch.label,
                                    pad=getattr(batch, "pad", 0))
+                # the always-on flight recorder sees every module step
+                # too (executor fwd/bwd attach as sub-phases when a
+                # timeline span is open)
+                telemetry_mod.flight.note_step(epoch, nbatch,
+                                               kind="module_step")
                 nbatch += 1
                 if batch_end_callback is not None:
                     p = BatchEndParam(epoch=epoch, nbatch=nbatch,

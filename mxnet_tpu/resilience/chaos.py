@@ -199,9 +199,19 @@ def chaos_scope(seed=0, rules=None, config=None):
 
 def fires(site) -> bool:
     """True when an armed injector fires at this site (no-op cost when
-    disarmed: one global read)."""
+    disarmed: one global read). Each firing is recorded as a ``chaos``
+    incident in the telemetry hub — post-mortems (flight dumps, merged
+    traces) show exactly which injected fault preceded a failure."""
     c = active()
-    return c is not None and c.fires(site)
+    if c is None or not c.fires(site):
+        return False
+    from .. import telemetry
+
+    span = telemetry.current_span()
+    ctx = {} if span is None else {"span_id": span.span_id,
+                                   "trace_id": span.trace_id}
+    telemetry.emit("chaos", site=site, **ctx)
+    return True
 
 
 def maybe_raise(site, exc=TransientError, message=None):

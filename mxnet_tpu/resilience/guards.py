@@ -172,6 +172,12 @@ class StepWatchdog:
         self.expired = True
         logging.error("step watchdog: no step completed within %.1fs",
                       self.deadline)
+        # black box first: a wedged step is exactly the state the flight
+        # recorder exists for (no-op unless MXNET_TPU_FLIGHT_DIR is set)
+        from .. import telemetry
+
+        telemetry.emit("watchdog", deadline=self.deadline)
+        telemetry.flight.auto_dump("watchdog")
         if self._abort:
             logging.critical(
                 "step watchdog: escalating to SIGTERM (preemption flush); "

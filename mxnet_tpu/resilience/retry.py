@@ -95,8 +95,18 @@ def retry_call(fn, policy: RetryPolicy, what="op", sleep=time.sleep,
             from .. import telemetry
 
             telemetry.counter("resilience_kv_retries_total")
+            # the retry incident attaches to the in-flight step span (its
+            # span_id parents it in the cross-rank merge and the flight
+            # recorder's incident ring)
+            span = telemetry.current_span()
+            ctx = {} if span is None else {"span_id": span.span_id,
+                                           "trace_id": span.trace_id}
             telemetry.emit("retry", op=what, attempt=attempt,
-                           error=type(e).__name__)
+                           error=type(e).__name__, **ctx)
+            if span is not None:
+                span.events.append({"name": "retry", "op": what,
+                                    "attempt": attempt,
+                                    "ts": time.perf_counter()})
             sleep(delay)
     try:
         return fn()  # final attempt carries the real failure out

@@ -31,23 +31,41 @@ from __future__ import annotations
 
 import os
 
-from .hub import MetricsHub, Histogram, hub, reset, DEFAULT_COUNTERS
+from .hub import (MetricsHub, Histogram, hub, reset, DEFAULT_COUNTERS,
+                  on_hub_create)
+from .distributed import (trace_id, set_trace_id, set_world, current_rank,
+                          world_size, rank_scope, mint_span_id, trace_ctx,
+                          emit_server_span, record_clock_beacon,
+                          merge_traces, detect_stragglers,
+                          load_rank_streams)
 from .timeline import (StepTimeline, Span, current_span,
                        clear_current_span, phase, timed)
 from .mfu import (MFUAccountant, resolve_peak_flops, measured_peak_flops,
                   record_compile_badput)
 from .exporters import (SCHEMA_VERSION, EVENT_GOLDEN_KEYS, JsonlWriter,
-                        write_jsonl, read_jsonl, prom_dump, serve_http,
-                        stop_http, summary)
+                        write_jsonl, read_jsonl, read_events, prom_dump,
+                        serve_http, stop_http, summary)
+from . import flight
+from .flight import FlightRecorder, validate_flight
+
+# the black box records from import on (and survives hub resets)
+flight.install()
 
 __all__ = [
     "MetricsHub", "Histogram", "hub", "reset", "DEFAULT_COUNTERS",
+    "on_hub_create",
+    "trace_id", "set_trace_id", "set_world", "current_rank", "world_size",
+    "rank_scope", "mint_span_id", "trace_ctx", "emit_server_span",
+    "record_clock_beacon", "merge_traces", "detect_stragglers",
+    "load_rank_streams",
     "StepTimeline", "Span", "current_span", "clear_current_span", "phase",
     "timed",
     "MFUAccountant", "resolve_peak_flops", "measured_peak_flops",
     "record_compile_badput",
     "SCHEMA_VERSION", "EVENT_GOLDEN_KEYS", "JsonlWriter", "write_jsonl",
-    "read_jsonl", "prom_dump", "serve_http", "stop_http", "summary",
+    "read_jsonl", "read_events", "prom_dump", "serve_http", "stop_http",
+    "summary",
+    "flight", "FlightRecorder", "validate_flight",
     "counter", "gauge", "observe", "emit", "TelemetryConfig",
     "maybe_serve_http_from_env",
 ]

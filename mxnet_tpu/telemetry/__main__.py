@@ -1,11 +1,21 @@
-"""CLI over exported telemetry JSONL logs.
+"""CLI over exported telemetry JSONL logs and flight dumps.
 
     python -m mxnet_tpu.telemetry tail run.jsonl [-n 20] [--kind span]
     python -m mxnet_tpu.telemetry summarize run.jsonl
+    python -m mxnet_tpu.telemetry merge r0.jsonl r1.jsonl ... -o fleet.json
+    python -m mxnet_tpu.telemetry diff A.jsonl B.jsonl [--threshold 10]
+    python -m mxnet_tpu.telemetry flight show dump.json [-n 10]
+    python -m mxnet_tpu.telemetry flight validate dump.json
 
-``tail`` prints the last N events, one formatted line each; ``summarize``
-digests the file: events per kind, span/phase time totals, badput buckets,
-and the MFU/goodput lines of each epoch_summary event.
+``tail`` prints the last N events; ``summarize`` digests one file (events
+per kind, span/phase time totals, badput buckets, MFU/goodput lines).
+``merge`` joins N per-rank streams on (trace_id, rank, step) into one
+clock-aligned fleet Chrome trace, prints the join report, and runs the
+straggler detector (``--no-stragglers`` to skip). ``diff`` compares
+step-time/MFU/goodput percentiles between two runs and exits nonzero on a
+regression beyond the threshold — a CI perf gate. ``flight`` renders and
+CRC-validates flight-recorder dumps. All readers take schema v1 (PR 5)
+and v2 (distributed tracing) files; v1 rows read as rank 0 of world 1.
 """
 
 from __future__ import annotations
@@ -15,7 +25,7 @@ import collections
 import json
 import sys
 
-from .exporters import read_jsonl
+from .exporters import read_events
 
 
 def _fmt_event(e):
@@ -31,7 +41,7 @@ def _fmt_event(e):
 
 
 def cmd_tail(args):
-    events = read_jsonl(args.path)
+    events = read_events(args.path)
     if args.kind:
         events = [e for e in events if e.get("kind") == args.kind]
     for e in events[-args.n:]:
@@ -40,13 +50,16 @@ def cmd_tail(args):
 
 
 def cmd_summarize(args):
-    events = read_jsonl(args.path)
+    events = read_events(args.path)
     if not events:
         print(f"{args.path}: no events")
         return 1
     by_kind = collections.Counter(e.get("kind", "?") for e in events)
+    ranks = sorted({e.get("rank", 0) for e in events})
     print(f"{args.path}: {len(events)} events "
-          f"(schema v{events[0].get('v', '?')})")
+          f"(schema v{events[0].get('v', '?')}, "
+          f"rank{'s' if len(ranks) > 1 else ''} "
+          f"{','.join(str(r) for r in ranks)})")
     for kind, n in by_kind.most_common():
         print(f"  {kind:<16s} {n}")
 
@@ -83,6 +96,162 @@ def cmd_summarize(args):
     return 0
 
 
+def cmd_merge(args):
+    from .distributed import detect_stragglers, load_rank_streams, \
+        merge_traces
+
+    by_rank = load_rank_streams(args.paths)
+    trace, report = merge_traces(by_rank, out=args.out)
+    print(f"merged {len(args.paths)} stream(s): "
+          f"ranks {report['ranks']}, {report['spans']} spans, "
+          f"{report['server_spans']} server spans "
+          f"({report['orphan_server_spans']} orphaned), "
+          f"trace ids {report['trace_ids'] or ['<none>']}")
+    if report.get("clock_offsets"):
+        offs = ", ".join(f"r{r}={o * 1e3:+.3f}ms"
+                         for r, o in sorted(report["clock_offsets"].items()))
+        print(f"clock offsets vs server: {offs}")
+    if args.out:
+        print(f"wrote {args.out} ({len(trace['traceEvents'])} trace events)")
+    if not args.no_stragglers:
+        srep = detect_stragglers(by_rank, mad_k=args.mad_k, publish=False)
+        print(f"skew: {srep['skew_seconds'] * 1e3:.3f} ms "
+              f"(slowest rank's median step vs fleet median)")
+        if srep["stragglers"]:
+            for s in srep["stragglers"]:
+                print(f"STRAGGLER rank {s['rank']}: blame={s['blame']} "
+                      f"({s['flagged_steps']}/{s['steps']} steps outside "
+                      f"the envelope, {s['excess_seconds'] * 1e3:.1f} ms "
+                      f"excess)")
+        else:
+            print("no stragglers flagged")
+    return 0
+
+
+# diff metrics: (label, extractor over events, higher_is_worse)
+def _span_dur_ms(events):
+    return sorted(float(e.get("dur_ms", 0.0)) for e in events
+                  if e.get("kind") == "span"
+                  and e.get("name", "step") == "step")
+
+
+def _pctl(sorted_vals, q):
+    """numpy's linear-interpolated percentile — the SAME math the hub's
+    Histogram reports, so the diff gate's p99 matches the live p99."""
+    if not sorted_vals:
+        return None
+    import numpy as np
+
+    return float(np.percentile(sorted_vals, q))
+
+
+def _run_metrics(events):
+    """The comparable health profile of one run's JSONL stream."""
+    durs = _span_dur_ms(events)
+    out = {}
+    for q in (50, 90, 99):
+        v = _pctl(durs, q)
+        if v is not None:
+            out[f"step_ms_p{q}"] = (v, True)   # higher = worse
+    mfu, goodput = [], []
+    for e in events:
+        if e.get("kind") == "epoch_summary":
+            if isinstance(e.get("mfu_pct"), (int, float)):
+                mfu.append(float(e["mfu_pct"]))
+            if isinstance(e.get("goodput_pct"), (int, float)):
+                goodput.append(float(e["goodput_pct"]))
+    if mfu:
+        out["mfu_pct"] = (sum(mfu) / len(mfu), False)  # lower = worse
+    if goodput:
+        out["goodput_pct"] = (sum(goodput) / len(goodput), False)
+    return out
+
+
+def cmd_diff(args):
+    a = _run_metrics(read_events(args.a))
+    b = _run_metrics(read_events(args.b))
+    if not a or not b:
+        print(f"error: no comparable metrics "
+              f"({args.a}: {sorted(a)}, {args.b}: {sorted(b)})",
+              file=sys.stderr)
+        return 2
+    breaches = 0
+    print(f"{'metric':<14s} {'A':>10s} {'B':>10s} {'delta':>9s}")
+    for name in sorted(set(a) & set(b)):
+        va, worse_up = a[name]
+        vb, _ = b[name]
+        if va == 0:
+            # no relative delta against a zero baseline — but a gate that
+            # drops a metric silently is a gate that lies; show the row
+            print(f"{name:<14s} {va:>10.3f} {vb:>10.3f} {'n/a':>9s}"
+                  f"  (zero baseline, not gated)")
+            continue
+        delta_pct = (vb - va) / abs(va) * 100.0
+        regression = delta_pct if worse_up else -delta_pct
+        flag = ""
+        if regression > args.threshold:
+            breaches += 1
+            flag = f"  REGRESSION (> {args.threshold:g}%)"
+        print(f"{name:<14s} {va:>10.3f} {vb:>10.3f} {delta_pct:>+8.1f}%"
+              f"{flag}")
+    only = sorted(set(a) ^ set(b))
+    if only:
+        print(f"(not comparable, present in one run only: {only})")
+    if breaches:
+        print(f"{breaches} regression(s) beyond {args.threshold:g}% "
+              f"threshold", file=sys.stderr)
+        return 3
+    return 0
+
+
+def cmd_flight(args):
+    from .flight import validate_flight
+
+    ok, payload = validate_flight(args.path)
+    if not ok:
+        print(f"INVALID flight dump {args.path}: {payload}",
+              file=sys.stderr)
+        return 3
+    if args.action == "validate":
+        print(f"{args.path}: CRC OK (format {payload.get('format')}, "
+              f"{len(payload.get('steps', []))} steps, "
+              f"{len(payload.get('incidents', []))} incidents)")
+        return 0
+    # show: the post-mortem rendering
+    print(f"flight dump {args.path}")
+    print(f"  reason={payload.get('reason')} rank={payload.get('rank')}/"
+          f"{payload.get('world_size')} trace={payload.get('trace_id')} "
+          f"pid={payload.get('pid')}")
+    steps = payload.get("steps", [])
+    print(f"last {min(args.n, len(steps))} of {len(steps)} recorded steps:")
+    for s in steps[-args.n:]:
+        if s.get("kind") == "step_lite":
+            print(f"  [e{s.get('epoch')} s{s.get('step')}] "
+                  f"{s.get('name', 'step')} (lite) "
+                  f"span={s.get('span_id')}")
+        else:
+            phases = " ".join(f"{p['name']}={p['dur_ms']:.2f}ms"
+                              for p in s.get("phases", ()))
+            print(f"  [e{s.get('epoch')} s{s.get('step')}] "
+                  f"{s.get('name', 'step')} {s.get('dur_ms', 0.0):.2f}ms "
+                  f"| {phases}")
+            for ev in s.get("events", ()):
+                print(f"      ! {ev.get('name')} "
+                      + " ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                                 if k not in ("name", "ts")))
+    incidents = payload.get("incidents", [])
+    if incidents:
+        print(f"incidents ({len(incidents)}):")
+        for e in incidents[-args.n:]:
+            print("  " + _fmt_event(e))
+    counters = payload.get("counters", {})
+    if counters:
+        print("non-zero counters:")
+        for k, v in sorted(counters.items()):
+            print(f"  {k}: {v:g}")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m mxnet_tpu.telemetry",
                                  description=__doc__,
@@ -97,6 +266,29 @@ def main(argv=None):
     s = sub.add_parser("summarize", help="digest an event log")
     s.add_argument("path")
     s.set_defaults(fn=cmd_summarize)
+    m = sub.add_parser("merge", help="join per-rank streams into one "
+                                     "fleet Chrome trace + straggler "
+                                     "report")
+    m.add_argument("paths", nargs="+")
+    m.add_argument("-o", "--out", default=None,
+                   help="write the merged Chrome trace JSON here")
+    m.add_argument("--no-stragglers", action="store_true")
+    m.add_argument("--mad-k", type=float, default=3.5,
+                   help="straggler envelope: median + K * MAD")
+    m.set_defaults(fn=cmd_merge)
+    d = sub.add_parser("diff", help="compare two runs; nonzero exit on "
+                                    "regression (CI perf gate)")
+    d.add_argument("a")
+    d.add_argument("b")
+    d.add_argument("--threshold", type=float, default=10.0,
+                   help="regression threshold in percent (default 10)")
+    d.set_defaults(fn=cmd_diff)
+    f = sub.add_parser("flight", help="render / CRC-validate a flight "
+                                      "recorder dump")
+    f.add_argument("action", choices=("show", "validate"))
+    f.add_argument("path")
+    f.add_argument("-n", type=int, default=10)
+    f.set_defaults(fn=cmd_flight)
     args = ap.parse_args(argv)
     try:
         return args.fn(args)
@@ -104,7 +296,7 @@ def main(argv=None):
         print(f"error: {e}", file=sys.stderr)
         return 2
     except json.JSONDecodeError as e:
-        print(f"error: {args.path} is not valid JSONL: {e}", file=sys.stderr)
+        print(f"error: invalid JSON input: {e}", file=sys.stderr)
         return 2
 
 

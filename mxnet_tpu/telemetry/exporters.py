@@ -15,18 +15,24 @@ from __future__ import annotations
 import json
 import threading
 
-from .hub import hub as _hub
+from .hub import hub as _hub, _rank_world
 
 __all__ = ["SCHEMA_VERSION", "EVENT_GOLDEN_KEYS", "JsonlWriter",
-           "write_jsonl", "read_jsonl", "prom_dump", "serve_http",
-           "stop_http", "summary"]
+           "write_jsonl", "read_jsonl", "read_events", "prom_dump",
+           "serve_http", "stop_http", "summary"]
 
-SCHEMA_VERSION = 1
+# v2 (ISSUE 6): every event carries rank/world_size; spans additionally
+# carry trace_id/span_id/wall_ts; new distributed-tracing kinds
+# (server_span, clock_beacon, server_stats, flight_dump, watchdog, chaos).
+# v1 files stay readable: read_events() fills the v2 identity defaults.
+SCHEMA_VERSION = 2
 
-# kind -> keys every event of that kind must carry (beyond v/kind/ts).
+# kind -> keys every event of that kind must carry (beyond v/kind/ts and
+# the v2 envelope rank/world_size).
 # Additive evolution only: new fields are fine, these may never disappear.
 EVENT_GOLDEN_KEYS = {
-    "span": ("name", "epoch", "step", "dur_ms", "phases"),
+    "span": ("name", "epoch", "step", "dur_ms", "phases",
+             "trace_id", "span_id", "rank"),
     "step_event": ("span_kind", "epoch", "step", "name"),
     "badput": ("reason", "seconds"),
     "epoch_summary": ("epoch", "steps", "seconds"),
@@ -34,6 +40,14 @@ EVENT_GOLDEN_KEYS = {
     "retry": ("op", "attempt"),
     "circuit_open": ("op",),
     "monitor": ("rows",),
+    # distributed tracing (v2)
+    "server_span": ("op", "dur_ms", "origin_rank", "start_ts"),
+    "server_dedup": ("op", "origin_rank"),
+    "clock_beacon": ("peer", "t_send", "t_peer", "t_recv"),
+    "server_stats": ("update_count",),
+    "flight_dump": ("reason", "path"),
+    "watchdog": ("deadline",),
+    "chaos": ("site",),
 }
 
 
@@ -41,14 +55,20 @@ EVENT_GOLDEN_KEYS = {
 
 class JsonlWriter:
     """Streaming JSONL sink; register with ``hub().add_sink(...)`` to
-    mirror every emitted event to disk as it happens."""
+    mirror every emitted event to disk as it happens. ``only_rank``
+    filters to one rank's events — the per-rank stream writer for the
+    in-process multi-worker harness, where every thread shares one hub."""
 
-    def __init__(self, path):
+    def __init__(self, path, only_rank=None):
         self.path = path
+        self.only_rank = only_rank
         self._lock = threading.Lock()
         self._f = open(path, "a", encoding="utf-8")
 
     def write_event(self, event):
+        if self.only_rank is not None and \
+                int(event.get("rank", 0)) != int(self.only_rank):
+            return
         line = json.dumps({"v": SCHEMA_VERSION, **event},
                           default=str, sort_keys=True)
         with self._lock:
@@ -77,6 +97,23 @@ def read_jsonl(path):
             if line:
                 out.append(json.loads(line))
     return out
+
+
+def read_events(path):
+    """Schema-aware reader: the backward-compat path for v1 files. Rows
+    from schema 1 (pre-distributed-tracing) gain the v2 identity defaults
+    — rank 0 of world 1, no trace/span id — so the CLI and the cross-rank
+    merge consume old and new logs uniformly."""
+    rows = read_jsonl(path)
+    for row in rows:
+        if int(row.get("v", 1)) < 2:
+            row.setdefault("rank", 0)
+            row.setdefault("world_size", 1)
+            if row.get("kind") == "span":
+                row.setdefault("trace_id", None)
+                row.setdefault("span_id", None)
+                row.setdefault("wall_ts", row.get("ts", 0.0))
+    return rows
 
 
 # -- Prometheus text exposition ------------------------------------------------
@@ -131,12 +168,17 @@ def prom_dump(h=None) -> str:
             lines.append(f"{pname}_count{_prom_labels(labels)} "
                          f"{value.count:g}")
             lines.append(f"{pname}_sum{_prom_labels(labels)} {value.sum:g}")
+    # collector-adapter families carry the same rank/world identity as the
+    # push metrics: per-rank /metrics endpoints scraped into one Prometheus
+    # must not collapse different ranks' compile/comm series into one
+    rank, world = _rank_world()
+    ident = {"rank": rank, "world_size": world}
     for name, value in sorted(h.collect().items()):
         if not isinstance(value, (int, float)):
             continue  # collector error messages are not exposable samples
         pname = _prom_name(name)
         _type_line(pname, "gauge")
-        lines.append(f"{pname} {value:g}")
+        lines.append(f"{pname}{_prom_labels(ident)} {value:g}")
     return "\n".join(lines) + "\n"
 
 

@@ -31,7 +31,26 @@ import collections
 import threading
 import time
 
-__all__ = ["MetricsHub", "Histogram", "hub", "reset", "DEFAULT_COUNTERS"]
+__all__ = ["MetricsHub", "Histogram", "hub", "reset", "DEFAULT_COUNTERS",
+           "set_rank_provider", "on_hub_create"]
+
+# (rank, world_size) identity provider — installed by telemetry.distributed
+# (thread-local rank scopes for the in-process multi-worker harness, the
+# active kvstore's rank otherwise). Every emitted event and every exported
+# metric family is stamped with it, so per-rank streams stay joinable.
+_RANK_PROVIDER = None
+
+
+def set_rank_provider(fn):
+    """``fn() -> (rank, world_size)``; see telemetry.distributed."""
+    global _RANK_PROVIDER
+    _RANK_PROVIDER = fn
+
+
+def _rank_world():
+    if _RANK_PROVIDER is None:
+        return 0, 1
+    return _RANK_PROVIDER()
 
 # Pre-declared counter families: wired subsystems increment these at
 # runtime, but they exist (at zero) from hub creation so a Prometheus
@@ -131,6 +150,9 @@ class MetricsHub:
         self._events = collections.deque(maxlen=ring_size)
         self._collectors = {}        # family -> callable() -> {name: value}
         self._sinks = []             # streaming event sinks (JsonlWriter)
+        self._kind_sinks = {}        # kind -> [sinks]: filtered sinks (the
+                                     # flight recorder) cost one dict.get
+                                     # per emit instead of a call per event
         self._epoch = time.time() - time.perf_counter()
         for name in DEFAULT_COUNTERS:
             self._counters[(name, ())] = 0.0
@@ -140,6 +162,11 @@ class MetricsHub:
         """Monotonic-derived wall-clock seconds (perf_counter resolution,
         epoch-anchored so event timestamps are comparable across files)."""
         return self._epoch + time.perf_counter()
+
+    def to_wall(self, perf_ts):
+        """Convert a time.perf_counter() reading into this hub's
+        epoch-anchored wall clock (the clock cross-rank merge aligns)."""
+        return self._epoch + float(perf_ts)
 
     # -- push metrics ---------------------------------------------------------
     def counter(self, name, value=1.0, **labels):
@@ -166,12 +193,19 @@ class MetricsHub:
 
     # -- events ---------------------------------------------------------------
     def emit(self, kind, **fields):
-        """Append one timestamped event to the ring (and any sinks)."""
+        """Append one timestamped event to the ring (and any sinks).
+        Every event is stamped with the emitting rank/world_size (explicit
+        fields win — a server emitting on behalf of a worker labels it)."""
+        rank, world = _rank_world()
         # kind/ts are the envelope and always win over payload fields
-        event = {**fields, "kind": kind, "ts": self.now()}
+        event = {"rank": rank, "world_size": world,
+                 **fields, "kind": kind, "ts": self.now()}
         with self._lock:
             self._events.append(event)
             sinks = tuple(self._sinks)
+            ksinks = self._kind_sinks.get(kind)
+            if ksinks:
+                sinks += tuple(ksinks)
         for sink in sinks:
             sink.write_event(event)
         return event
@@ -183,15 +217,31 @@ class MetricsHub:
             evs = [e for e in evs if e["kind"] == kind]
         return evs[-limit:] if limit else evs
 
-    def add_sink(self, sink):
+    def add_sink(self, sink, kinds=None):
+        """Register a streaming event sink. With ``kinds`` (an iterable of
+        event kinds) the sink only sees those kinds — and costs the hot
+        path one dict lookup instead of a call per event (the flight
+        recorder's contract); without, it sees everything (JsonlWriter)."""
         with self._lock:
-            self._sinks.append(sink)
+            if kinds is None:
+                self._sinks.append(sink)
+            else:
+                for k in kinds:
+                    self._kind_sinks.setdefault(k, []).append(sink)
         return sink
 
     def remove_sink(self, sink):
         with self._lock:
             if sink in self._sinks:
                 self._sinks.remove(sink)
+            for lst in self._kind_sinks.values():
+                if sink in lst:
+                    lst.remove(sink)
+
+    def has_sink(self, sink):
+        with self._lock:
+            return sink in self._sinks or \
+                any(sink in lst for lst in self._kind_sinks.values())
 
     # -- pull adapters --------------------------------------------------------
     def register_collector(self, family, fn):
@@ -238,19 +288,36 @@ class MetricsHub:
     def iter_metrics(self):
         """(type, name, labels-dict, value-or-Histogram) rows for export.
         Histograms are copied under the lock: the /metrics HTTP thread
-        reads them while the train loop observes into the live ones."""
+        reads them while the train loop observes into the live ones.
+        Every family carries rank/world_size labels (injected at export
+        time so the hot-path keys stay tiny; explicit labels win)."""
+        rank, world = _rank_world()
+        ident = {"rank": rank, "world_size": world}
         with self._lock:
-            rows = [("counter", n, dict(l), v)
+            rows = [("counter", n, {**ident, **dict(l)}, v)
                     for (n, l), v in self._counters.items()]
-            rows += [("gauge", n, dict(l), v)
+            rows += [("gauge", n, {**ident, **dict(l)}, v)
                      for (n, l), v in self._gauges.items()]
-            rows += [("histogram", n, dict(l), h.copy())
+            rows += [("histogram", n, {**ident, **dict(l)}, h.copy())
                      for (n, l), h in self._hists.items()]
         return rows
 
 
 _HUB = None
 _HUB_LOCK = threading.Lock()
+_ON_CREATE = []  # callbacks run on every fresh hub (flight recorder attach)
+
+
+def on_hub_create(fn):
+    """Register ``fn(hub)`` to run on every hub creation — including after
+    :func:`reset` — so always-on attachments (the flight recorder sink)
+    survive test-style hub replacement. Runs immediately if a hub exists."""
+    _ON_CREATE.append(fn)
+    with _HUB_LOCK:
+        h = _HUB
+    if h is not None:
+        fn(h)
+    return fn
 
 
 def _install_default_collectors(h: MetricsHub):
@@ -291,6 +358,13 @@ def hub() -> MetricsHub:
             if _HUB is None:
                 h = MetricsHub()
                 _install_default_collectors(h)
+                # attach hooks run BEFORE the hub is published: a
+                # concurrent emit() must never reach a hub missing its
+                # always-on sinks (the flight recorder would drop the one
+                # incident that explains a crash). Callbacks get the hub
+                # as an argument and must not call hub() themselves.
+                for fn in list(_ON_CREATE):
+                    fn(h)
                 _HUB = h
     return _HUB
 

@@ -37,6 +37,7 @@ import json
 import threading
 import time
 
+from . import distributed as _dist
 from .hub import hub as _hub
 
 __all__ = ["Span", "StepTimeline", "current_span", "clear_current_span",
@@ -93,22 +94,39 @@ class Span:
 
     Usage: ``span.mark("dispatch")`` closes the previous phase and opens
     ``dispatch``; ``span.end()`` closes the last one. Phases are therefore
-    non-overlapping by construction."""
+    non-overlapping by construction. Every span carries the run's
+    ``trace_id``, its own deterministic ``span_id``, and the recording
+    ``rank`` — the join keys of the cross-rank merge (telemetry
+    .distributed); kvstore server handling parents onto ``span_id``.
+    Spans work as context managers (``with tl.begin_step(...) as span:``
+    — exit closes the span; mxlint MX307 polices leaked ones)."""
 
     __slots__ = ("kind", "epoch", "step", "start", "end_ts", "_marks",
-                 "subs", "events", "_timeline")
+                 "subs", "events", "_timeline", "span_id", "trace_id",
+                 "rank")
 
     def __init__(self, timeline, kind, epoch, step, start, data_wait=0.0):
         self._timeline = timeline
         self.kind = kind
         self.epoch = epoch
         self.step = step
+        self.rank = _dist.current_rank()
+        self.trace_id = _dist.trace_id()
+        self.span_id = _dist.mint_span_id(self.rank, epoch, step, kind)
         # the span covers the data wait that preceded batch availability
         self.start = start - data_wait
         self._marks = [("data_wait", self.start)] if data_wait else []
         self.end_ts = None
         self.subs = []      # (name, start, dur) nested records (kvstore, ..)
         self.events = []    # instant events (retry, skip, ...)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.end_ts is None:
+            self.end()
+        return False
 
     def mark(self, name, ts=None):
         self._marks.append((name, time.perf_counter() if ts is None else ts))
@@ -148,10 +166,19 @@ class Span:
     def to_dict(self):
         return {
             "name": self.kind, "epoch": self.epoch, "step": self.step,
-            "ts": self.start, "dur_ms": self.duration * 1e3,
-            "phases": [{"name": n, "ts": t, "dur_ms": d * 1e3}
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "rank": self.rank,
+            "ts": self.start, "wall_ts": _hub().to_wall(self.start),
+            "dur_ms": self.duration * 1e3,
+            # rel_ms: offset from span start — the envelope "ts" of a
+            # hub-emitted span event is the (wall-clock) emit time, so
+            # consumers must NOT rebase phases against it; rel_ms is the
+            # clock-free join the cross-rank merge uses
+            "phases": [{"name": n, "ts": t, "dur_ms": d * 1e3,
+                        "rel_ms": (t - self.start) * 1e3}
                        for n, t, d in self.phases()],
-            "subs": [{"name": n, "ts": t, "dur_ms": d * 1e3}
+            "subs": [{"name": n, "ts": t, "dur_ms": d * 1e3,
+                      "rel_ms": (t - self.start) * 1e3}
                      for n, t, d in self.subs],
             "events": list(self.events),
         }
@@ -255,7 +282,24 @@ class StepTimeline:
 
     def dump_jsonl(self, path):
         """Schema-versioned JSONL of the spans (exporters.write_jsonl)."""
-        from . import exporters
+        from . import distributed, exporters
 
+        world = distributed.world_size()
         return exporters.write_jsonl(
-            path, (s.to_dict() | {"kind": "span"} for s in self.spans))
+            path, (s.to_dict() | {"kind": "span", "world_size": world}
+                   for s in self.spans))
+
+    def dump_flight(self, path=None, reason="manual"):
+        """Write the process flight recorder's black box (last K steps +
+        incidents, CRC-sealed) — ``model.telemetry.dump_flight()`` is the
+        on-demand crash-forensics entry point. Without ``path``, dumps
+        into MXNET_TPU_FLIGHT_DIR (error if neither is given)."""
+        from . import flight
+
+        if path is not None:
+            return flight.dump(path, reason=reason)
+        out = flight.auto_dump(reason)
+        if out is None:
+            raise ValueError(
+                "dump_flight() needs a path or MXNET_TPU_FLIGHT_DIR")
+        return out

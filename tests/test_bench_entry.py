@@ -65,6 +65,33 @@ def test_bench_telemetry_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_TELEMETRY_*
 
 
+def test_bench_trace_smoke_json_contract():
+    """--trace-bench --smoke is the CI guard on the distributed-tracing
+    bench entry: one JSON line with the contract keys, per-op tracing
+    costs measured, and the ISSUE 6 acceptance bound — flight recorder +
+    trace propagation under 2% of the dp-8 baseline step."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--trace-bench", "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "note_ns",
+                "sink_ns", "ctx_ns", "mint_ns", "step_ms_baseline",
+                "step_ms_traced", "traced_overhead_pct",
+                "flight_steps_recorded"):
+        assert key in blob, blob
+    assert blob["metric"] == "trace_flight_overhead_pct_of_step"
+    assert blob["note_ns"] > 0 and blob["step_ms_baseline"] > 0
+    # the acceptance bound: always-on tracing costs <2% of a step
+    assert 0 < blob["value"] < 2.0, blob
+    assert blob["flight_steps_recorded"] > 0  # the black box was live
+    assert blob["smoke"] is True  # smoke runs never write BENCH_TRACE_*
+
+
 @pytest.mark.slow
 def test_bench_pipeline_mode_json_contract(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")

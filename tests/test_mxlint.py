@@ -388,6 +388,110 @@ def test_tree_has_no_mx306_findings():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+# -- MX307 leaked-span fixtures (ISSUE 6 satellite) ----------------------------
+
+def test_fixture_mx307_leaked_span():
+    src = (
+        "def loop(tl, batches):\n"
+        "    for i, b in enumerate(batches):\n"
+        "        span = tl.begin_step(0, i)\n"
+        "        span.mark('device')\n"
+        "        step(b)\n"
+    )
+    findings = lint_source(src, "fx.py")
+    assert _ids(findings) == ["MX307"]
+    assert findings[0].line == 3
+
+
+def test_fixture_mx307_bare_calls():
+    # a discarded begin_step can never be ended
+    src = (
+        "def loop(tl):\n"
+        "    tl.begin_step(0, 0)\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == ["MX307"]
+    # phase()/timed() return context managers; a bare call records nothing
+    src2 = (
+        "from mxnet_tpu import telemetry\n"
+        "def push(kv, grads):\n"
+        "    telemetry.phase('kvstore_push')\n"
+        "    kv.push_many(grads)\n"
+    )
+    assert _ids(lint_source(src2, "fx.py")) == ["MX307"]
+    src3 = (
+        "from mxnet_tpu.telemetry import timed\n"
+        "def stage(x):\n"
+        "    timed('stage')\n"
+        "    return work(x)\n"
+    )
+    assert _ids(lint_source(src3, "fx.py")) == ["MX307"]
+
+
+def test_fixture_mx307_clean_patterns():
+    # context-manager span: __exit__ closes it
+    src = (
+        "def loop(tl, batches):\n"
+        "    for i, b in enumerate(batches):\n"
+        "        with tl.begin_step(0, i) as span:\n"
+        "            span.mark('device')\n"
+        "            step(b)\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+    # explicit .end() anywhere in the function (incl. a finally)
+    src2 = (
+        "def loop(tl, batches):\n"
+        "    for i, b in enumerate(batches):\n"
+        "        span = tl.begin_step(0, i)\n"
+        "        try:\n"
+        "            step(b)\n"
+        "        finally:\n"
+        "            span.end()\n"
+    )
+    assert _ids(lint_source(src2, "fx.py")) == []
+    # the fit-loop shape: conditional open, conditional end
+    src3 = (
+        "def loop(tl, batches):\n"
+        "    for i, b in enumerate(batches):\n"
+        "        span = tl.begin_step(0, i) if tl is not None else None\n"
+        "        step(b)\n"
+        "        if span is not None:\n"
+        "            span.end()\n"
+    )
+    assert _ids(lint_source(src3, "fx.py")) == []
+    # with-entered phase is the sanctioned use
+    src4 = (
+        "from mxnet_tpu import telemetry\n"
+        "def push(kv, grads):\n"
+        "    with telemetry.phase('kvstore_push'):\n"
+        "        kv.push_many(grads)\n"
+    )
+    assert _ids(lint_source(src4, "fx.py")) == []
+
+
+def test_fixture_mx307_pragma_and_exempt_paths():
+    src = (
+        "def loop(tl):\n"
+        "    span = tl.begin_step(0, 0)  # mxlint: disable=MX307\n"
+        "    step()\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+    src2 = src.replace("  # mxlint: disable=MX307", "")
+    # the primitives' home is exempt wholesale
+    assert _ids(lint_source(
+        src2, "mxnet_tpu/telemetry/timeline.py")) == []
+
+
+def test_tree_has_no_mx307_findings():
+    """ISSUE 6 satellite: the tree self-lints clean of leaked spans —
+    every begin_step is closed on every path and every phase()/timed()
+    is with-entered."""
+    from mxnet_tpu.analysis import lint_paths
+
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX307"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 # -- Pass 2: graph verifier fixtures ------------------------------------------
 
 def test_fixture_duplicate_argument():

@@ -125,6 +125,15 @@ def test_jsonl_schema_golden_keys(tmp_path):
     h.emit("retry", op="push", attempt=1)
     h.emit("circuit_open", op="kvstore")
     h.emit("monitor", rows=7)
+    # distributed-tracing kinds (schema v2)
+    h.emit("server_span", op="push", dur_ms=0.2, origin_rank=1,
+           start_ts=h.now(), parent_span="t-r1-e0-s0", dedup=False)
+    h.emit("server_dedup", op="push", origin_rank=1)
+    telemetry.record_clock_beacon("server", h.now(), h.now(), h.now())
+    h.emit("server_stats", update_count=3)
+    h.emit("flight_dump", reason="manual", path="/tmp/f.json")
+    h.emit("watchdog", deadline=5.0)
+    h.emit("chaos", site="kvstore.push")
     path = str(tmp_path / "events.jsonl")
     telemetry.write_jsonl(path, h.events())
     rows = telemetry.read_jsonl(path)
@@ -132,12 +141,33 @@ def test_jsonl_schema_golden_keys(tmp_path):
     for row in rows:
         assert row["v"] == telemetry.SCHEMA_VERSION
         assert "ts" in row and "kind" in row
+        # the v2 envelope: every event carries its rank identity
+        assert "rank" in row and "world_size" in row, row
         kind = row["kind"]
         for key in telemetry.EVENT_GOLDEN_KEYS.get(kind, ()):
             assert key in row, (kind, key, row)
         seen.add(kind)
     assert set(telemetry.EVENT_GOLDEN_KEYS) <= seen, \
         f"kinds never emitted: {set(telemetry.EVENT_GOLDEN_KEYS) - seen}"
+
+
+def test_read_events_v1_backward_compat(tmp_path):
+    """Schema v1 files (PR 5, pre-distributed-tracing) stay readable:
+    read_events fills the v2 identity defaults (rank 0 of world 1)."""
+    import json
+
+    path = str(tmp_path / "v1.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"v": 1, "kind": "span", "ts": 1.0, "name":
+                            "step", "epoch": 0, "step": 0, "dur_ms": 2.0,
+                            "phases": []}) + "\n")
+        f.write(json.dumps({"v": 1, "kind": "retry", "ts": 2.0,
+                            "op": "push", "attempt": 0}) + "\n")
+    rows = telemetry.read_events(path)
+    assert all(r["rank"] == 0 and r["world_size"] == 1 for r in rows)
+    span = rows[0]
+    assert span["span_id"] is None and span["trace_id"] is None
+    assert span["wall_ts"] == span["ts"]
 
 
 def test_prom_dump_format_and_adapters():
@@ -148,10 +178,12 @@ def test_prom_dump_format_and_adapters():
         h.observe("lat_seconds", v)
     dump = telemetry.prom_dump()
     assert "# TYPE mxtpu_widgets_total counter" in dump
-    assert 'mxtpu_widgets_total{kind="a b"} 3' in dump
-    assert "mxtpu_depth 2.5" in dump
+    # every family carries the rank/world identity labels (ISSUE 6)
+    assert ('mxtpu_widgets_total{kind="a b",rank="0",world_size="1"} 3'
+            in dump)
+    assert 'mxtpu_depth{rank="0",world_size="1"} 2.5' in dump
     assert "# TYPE mxtpu_lat_seconds summary" in dump
-    assert "mxtpu_lat_seconds_count 4" in dump
+    assert 'mxtpu_lat_seconds_count{rank="0",world_size="1"} 4' in dump
     assert 'quantile="0.5"' in dump
     # registry adapters: compile + comm families present via collectors
     assert "mxtpu_compile_compiles_total" in dump
@@ -164,7 +196,7 @@ def test_http_endpoint_serves_metrics():
     telemetry.counter("http_probe_total", 5)
     body = urllib.request.urlopen(
         f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
-    assert "mxtpu_http_probe_total 5" in body
+    assert 'mxtpu_http_probe_total{rank="0",world_size="1"} 5' in body
     health = urllib.request.urlopen(
         f"http://127.0.0.1:{port}/healthz", timeout=10).read().decode()
     assert health == "ok\n"
@@ -341,10 +373,13 @@ def test_fit_telemetry_end_to_end(tmp_path, caplog):
     # steady-state step (epoch 1+: compile amortized)
     h = telemetry.hub()
     reps = 5000
-    t0 = time.perf_counter()
-    for i in range(reps):
-        h.emit("bench", i=i)
-    emit_s = (time.perf_counter() - t0) / reps
+    batches = []
+    for _ in range(3):  # best-of-3: full-suite CPU contention de-noised
+        t0 = time.perf_counter()
+        for i in range(reps):
+            h.emit("bench", i=i)
+        batches.append((time.perf_counter() - t0) / reps)
+    emit_s = min(batches)
     steady = [s.duration for s in steps[steps_per_epoch:]]
     mean_step = sum(steady) / len(steady)
     hub_ops_per_step = 10

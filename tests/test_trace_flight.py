@@ -1,0 +1,523 @@
+"""Distributed tracing + flight recorder acceptance (ISSUE 6).
+
+Covers: trace/rank identity and its propagation through the kvstore
+envelopes (in-process group BSP server and the dist_async parameter
+host), server-side handling and replay-dedup hits as child spans of the
+worker step that caused them, the always-on flight recorder (ring
+semantics, CRC-sealed atomic dumps, crash-path triggers), cross-rank
+JSONL merge into one fleet Chrome trace with clock-offset beacons, the
+MAD-envelope straggler detector with per-phase blame, the `diff` CI perf
+gate, exporter thread-safety under concurrent scrapes, and the end-to-end
+chaos acceptance: slow rank + dropped pushes + NaN-step incident +
+mid-run-killed worker -> surviving ranks' dumps valid, one merged trace
+spanning all ranks, the injected straggler named with the right blame.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.kvstore import create_group
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.resilience.chaos import chaos_scope
+from mxnet_tpu.telemetry import flight
+from mxnet_tpu.telemetry.__main__ import main as telemetry_cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    telemetry.reset()
+    flight.reset()
+    telemetry.set_world(0, 1)
+    yield
+    telemetry.reset()
+    flight.reset()
+    telemetry.set_world(0, 1)
+
+
+# -- identity ------------------------------------------------------------------
+
+def test_rank_scope_and_event_stamping():
+    e0 = telemetry.emit("tick")
+    assert e0["rank"] == 0 and e0["world_size"] == 1
+    telemetry.set_world(2, 8)
+    assert telemetry.emit("tick")["rank"] == 2
+    with telemetry.rank_scope(5, 8):
+        assert telemetry.emit("tick")["rank"] == 5
+        # metric families carry the scoped identity at export time
+        telemetry.counter("scoped_total")
+        assert ('mxtpu_scoped_total{rank="5",world_size="8"} 1'
+                in telemetry.prom_dump())
+    assert telemetry.emit("tick")["rank"] == 2  # scope restored
+
+
+def test_span_identity_is_deterministic_and_joinable():
+    tid = telemetry.trace_id()
+    assert telemetry.trace_id() == tid  # stable within the run
+    with telemetry.rank_scope(3, 4):
+        tl = telemetry.StepTimeline()
+        with tl.begin_step(7, 11) as span:
+            pass
+    assert span.rank == 3 and span.trace_id == tid
+    # any rank can re-derive the id — the merge join key
+    assert span.span_id == telemetry.mint_span_id(3, 7, 11)
+    d = span.to_dict()
+    for key in ("trace_id", "span_id", "rank", "wall_ts"):
+        assert key in d, key
+
+
+def test_trace_id_adoption_rules():
+    mine = telemetry.trace_id()
+    # adopt=True never re-brands a run that already has an id
+    assert telemetry.set_trace_id("other", adopt=True) == mine
+    # an explicit set (worker adopting rank 0's id) wins
+    assert telemetry.set_trace_id("fleet-id") == "fleet-id"
+    assert telemetry.trace_id() == "fleet-id"
+
+
+# -- flight recorder -----------------------------------------------------------
+
+def test_flight_rings_route_and_bound():
+    rec = flight.recorder()
+    for i in range(200):
+        telemetry.emit("span", name="step", epoch=0, step=i, dur_ms=1.0,
+                       phases=[])
+    telemetry.emit("retry", op="push", attempt=0)
+    telemetry.emit("chaos", site="kvstore.push")
+    steps, events, incidents = rec.snapshot()
+    assert len(steps) == 64  # ring-bounded to the last K
+    assert steps[-1]["step"] == 199
+    kinds = {e["kind"] for e in incidents}
+    assert "retry" in kinds and "chaos" in kinds
+    # a noisy event stream cannot evict incidents: spam and re-check
+    for i in range(2000):
+        telemetry.emit("noise", i=i)
+    _, _, incidents = rec.snapshot()
+    assert {e["kind"] for e in incidents} >= {"retry", "chaos"}
+
+
+def test_flight_dump_crc_and_tamper_detection(tmp_path):
+    flight.note_step(0, 0)
+    telemetry.emit("retry", op="push", attempt=1)
+    path = str(tmp_path / "f.json")
+    out = flight.dump(path, reason="unit")
+    assert out == path and not os.listdir(str(tmp_path)).count("tmp")
+    ok, payload = telemetry.validate_flight(path)
+    assert ok, payload
+    assert payload["reason"] == "unit"
+    assert payload["trace_id"] == telemetry.trace_id()
+    assert any(s.get("kind") == "step_lite" for s in payload["steps"])
+    assert any(e.get("kind") == "retry" for e in payload["incidents"])
+    # a flight_dump event was emitted (observable in traces)
+    assert telemetry.hub().events("flight_dump")
+    # tamper: flip a byte inside the payload -> CRC fails closed
+    blob = json.load(open(path))
+    blob["payload"]["reason"] = "doctored"
+    json.dump(blob, open(path, "w"))
+    ok, err = telemetry.validate_flight(path)
+    assert not ok and "CRC" in err
+
+
+def test_dump_flight_from_model_timeline(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = rng.randint(0, 4, (64,)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        data, name="fc", num_hidden=4), name="softmax")
+    model = mx.FeedForward(out, ctx=mx.cpu(), num_epoch=1,
+                           learning_rate=0.1)
+    model.fit(X, y, batch_size=32, telemetry=True)
+    path = model.telemetry.dump_flight(str(tmp_path / "fit.json"))
+    ok, payload = telemetry.validate_flight(path)
+    assert ok
+    full = [s for s in payload["steps"] if s.get("kind") == "span"]
+    assert len(full) == 2  # one per step, with phase breakdowns
+    assert all(s["phases"] for s in full)
+    # without a path and without MXNET_TPU_FLIGHT_DIR: explicit error
+    with pytest.raises(ValueError):
+        model.telemetry.dump_flight()
+
+
+def test_flight_auto_dump_env_gated(tmp_path, monkeypatch):
+    assert flight.auto_dump("unit") is None  # no dir -> no-op
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    flight.note_step(0, 1)
+    path = flight.auto_dump("unit")
+    assert path is not None and os.path.exists(path)
+    assert "unit" in os.path.basename(path)
+    ok, _ = telemetry.validate_flight(path)
+    assert ok
+
+
+def test_fit_without_timeline_still_records_flight_steps():
+    rng = np.random.RandomState(0)
+    X = rng.randn(96, 8).astype(np.float32)
+    y = rng.randint(0, 4, (96,)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        data, name="fc", num_hidden=4), name="softmax")
+    model = mx.FeedForward(out, ctx=mx.cpu(), num_epoch=1,
+                           learning_rate=0.1)
+    model.fit(X, y, batch_size=32)  # telemetry OFF
+    steps, _, _ = flight.recorder().snapshot()
+    lite = [s for s in steps if s.get("kind") == "step_lite"]
+    assert len(lite) == 3
+    assert [s["step"] for s in lite] == [0, 1, 2]
+
+
+# -- exporter concurrency (satellite) ------------------------------------------
+
+def test_concurrent_emit_while_exporters_scrape():
+    """Hammer emit()/observe()/counter() from trainer threads while
+    prom_dump(), the /metrics HTTP endpoint, and snapshot() poll: no torn
+    reads, no exceptions, no lock-order inversions (deadlock == timeout
+    here), and the final counts add up."""
+    import urllib.request
+
+    port = telemetry.serve_http(0)
+    errors = []
+    stop = threading.Event()
+    N, THREADS = 2000, 4
+
+    def writer(tid):
+        try:
+            with telemetry.rank_scope(tid, THREADS):
+                for i in range(N):
+                    telemetry.emit("hammer", tid=tid, i=i)
+                    telemetry.observe("hammer_seconds", i * 1e-6,
+                                      tid=tid)
+                    telemetry.counter("hammer_total")
+        except Exception as e:  # noqa: BLE001 - the assertion surface
+            errors.append(("writer", e))
+
+    def reader(kind):
+        try:
+            while not stop.is_set():
+                if kind == "prom":
+                    out = telemetry.prom_dump()
+                    assert "mxtpu_" in out
+                elif kind == "http":
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=10).read()
+                else:
+                    telemetry.hub().snapshot()
+        except Exception as e:  # noqa: BLE001
+            errors.append((kind, e))
+
+    writers = [threading.Thread(target=writer, args=(t,))
+               for t in range(THREADS)]
+    readers = [threading.Thread(target=reader, args=(k,))
+               for k in ("prom", "http", "snapshot")]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join(timeout=60)
+    stop.set()
+    for t in readers:
+        t.join(timeout=60)
+    telemetry.stop_http()
+    assert not errors, errors
+    assert not any(t.is_alive() for t in writers + readers), "deadlock"
+    snap = telemetry.hub().snapshot()
+    assert snap["counters"]["hammer_total"] == N * THREADS
+    hist = sum(v["count"] for k, v in snap["histograms"].items()
+               if k.startswith("hammer_seconds"))
+    assert hist == N * THREADS
+
+
+# -- kvstore propagation -------------------------------------------------------
+
+def _worker_loop(kv, rank, world, steps, jsonl_dir, slow_rank=None,
+                 die_after=None, chaos=False):
+    """One emulated worker: per-rank JSONL stream, per-step spans with
+    device/kvstore phases, BSP push/pull each step. ``die_after`` models
+    a SIGKILL'd worker: the thread keeps serving the BSP protocol (a real
+    kill would stall the collective — out of scope here) but its
+    telemetry stream and flight dump stop cold, mid-span."""
+    with telemetry.rank_scope(rank, world):
+        sink = telemetry.hub().add_sink(telemetry.JsonlWriter(
+            os.path.join(jsonl_dir, f"rank{rank}.jsonl"), only_rank=rank))
+        tl = telemetry.StepTimeline()
+        grad = NDArray(np.ones(8, np.float32))
+        out = NDArray(np.zeros(8, np.float32))
+        try:
+            # init barriers across the group: every worker calls it from
+            # its own thread (rank 0 seeds the server)
+            kv.init("w", NDArray(np.zeros(8, np.float32)))
+            for step in range(steps):
+                dead = die_after is not None and step >= die_after
+                span = None if dead else tl.begin_step(0, step)
+                if span is not None:
+                    span.mark("device")
+                # the skew must survive full-suite CPU contention (fast
+                # ranks' sleeps stretch under load, compressing it)
+                time.sleep(0.05 if rank == slow_rank else 0.004)
+                if span is not None:
+                    span.mark("kvstore")
+                kv.push("w", grad)
+                kv.pull("w", out)
+                if span is not None:
+                    span.mark("host")
+                    if step == 1 and rank == 0:
+                        # the NaN-step stand-in: a guard skip incident
+                        span.event("step_retry", reason="nonfinite")
+                    span.end()
+            if die_after is None:
+                flight.dump(os.path.join(jsonl_dir,
+                                         f"flight_r{rank}.json"),
+                            reason="test", only_rank=rank)
+        finally:
+            telemetry.clear_current_span()
+            telemetry.hub().remove_sink(sink)
+            sink.close()
+
+
+def test_group_push_parents_server_spans_and_dedups_under_chaos(tmp_path):
+    """Worker pushes carry trace context: the BSP server's handling lands
+    as server_span events parented under the exact worker step span, and
+    a chaos-dropped ack (resend of the same (worker, seq)) surfaces as a
+    server_dedup incident instead of a double-count."""
+    world = 2
+    workers = create_group(world)
+    with chaos_scope(seed=5, rules={"group.push.ack": {1}}):
+        ts = [threading.Thread(target=_worker_loop,
+                               args=(w, r, world, 3, str(tmp_path)),
+                               kwargs={"chaos": True})
+              for r, w in enumerate(workers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+    assert not any(t.is_alive() for t in ts)
+    evs = telemetry.hub().events()
+    spans = {e["span_id"]: e for e in evs if e["kind"] == "span"}
+    server = [e for e in evs if e["kind"] == "server_span"]
+    assert server, "no server spans recorded"
+    for e in server:
+        assert e["parent_span"] in spans, e
+        # parented under a step of the ORIGIN rank
+        assert spans[e["parent_span"]]["rank"] == e["origin_rank"]
+    dedups = [e for e in evs if e["kind"] == "server_dedup"]
+    assert dedups and workers[0]._server.duplicate_count == len(dedups)
+    assert all(d["parent_span"] in spans for d in dedups)
+    # retry incidents carry the span they interrupted
+    retries = [e for e in evs if e["kind"] == "retry"]
+    assert retries and all(e.get("span_id") in spans for e in retries)
+
+
+def test_bsp_push_span_excludes_collective_wait(tmp_path):
+    """A fast rank's BSP push blocks in the server's cv.wait_for until
+    the slow rank arrives — that is straggler skew, not server work, and
+    must land in barrier_wait_ms, NOT in the server_span's dur_ms (or the
+    fleet trace would blame the parameter server for the slow rank)."""
+    world = 2
+    workers = create_group(world)
+    ts = [threading.Thread(target=_worker_loop,
+                           args=(w, r, world, 3, str(tmp_path)),
+                           kwargs={"slow_rank": 1})
+          for r, w in enumerate(workers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in ts)
+    fast_pushes = [e for e in telemetry.hub().events()
+                   if e["kind"] == "server_span" and e["op"] == "push"
+                   and e["origin_rank"] == 0]
+    assert fast_pushes
+    waited = [e for e in fast_pushes if e["barrier_wait_ms"] > 5.0]
+    assert waited, "fast rank's pushes never waited on the slow rank"
+    for e in waited:
+        # handling (decode + accumulate of 8 floats) is far below wait
+        assert e["dur_ms"] < e["barrier_wait_ms"], e
+
+
+# -- the end-to-end chaos acceptance -------------------------------------------
+
+def test_chaos_fleet_flight_merge_straggler(tmp_path):
+    """ISSUE 6 acceptance: under injected faults — slow rank 2, dropped
+    pushes, a NaN-step incident on rank 0, rank 3's recorder killed
+    mid-run — the surviving ranks' flight dumps are CRC-clean with the
+    last K steps and incidents attached; `merge` yields ONE Chrome trace
+    spanning all ranks with server spans parented under the right worker
+    steps; the straggler detector names rank 2 and blames the device
+    phase."""
+    world, steps, slow = 4, 8, 2
+    workers = create_group(world)
+    # probability-based drops: several pushes fail/lose acks across the
+    # fleet (seeded; retries + server dedup keep BSP correctness)
+    with chaos_scope(seed=11, rules={"group.push.send": 0.12,
+                                     "group.push.ack": 0.08}):
+        ts = []
+        for r, w in enumerate(workers):
+            kwargs = {"slow_rank": slow}
+            if r == 3:
+                kwargs["die_after"] = 5  # "SIGKILL" at step 5
+            ts.append(threading.Thread(
+                target=_worker_loop,
+                args=(w, r, world, steps, str(tmp_path)), kwargs=kwargs))
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+    assert not any(t.is_alive() for t in ts)
+
+    # -- surviving ranks' flight dumps are valid ------------------------------
+    for r in (0, 1, 2):
+        path = str(tmp_path / f"flight_r{r}.json")
+        ok, payload = telemetry.validate_flight(path)
+        assert ok, (r, payload)
+        assert payload["rank"] == r
+        got = [s for s in payload["steps"] if s.get("kind") == "span"]
+        assert len(got) == steps, (r, len(got))  # last K covers the run
+        assert [s["step"] for s in got] == list(range(steps))
+    assert not os.path.exists(str(tmp_path / "flight_r3.json"))  # killed
+    # incidents attached: each surviving dump carries what ITS rank saw;
+    # across the fleet the chaos drops, the retries they forced, and the
+    # NaN-step guard event are all on record
+    incidents = []
+    for r in (0, 1, 2):
+        _, p = telemetry.validate_flight(str(tmp_path / f"flight_r{r}.json"))
+        incidents.extend(p["incidents"])
+    kinds = {e["kind"] for e in incidents}
+    assert "chaos" in kinds and "retry" in kinds, kinds
+    assert any(e["kind"] == "step_event" and e.get("name") == "step_retry"
+               and e.get("rank") == 0 for e in incidents)
+
+    # -- one merged fleet trace -----------------------------------------------
+    paths = [str(tmp_path / f"rank{r}.jsonl") for r in range(world)]
+    out = str(tmp_path / "fleet.json")
+    trace, report = telemetry.merge_traces(paths, out=out)
+    assert sorted(report["ranks"]) == [0, 1, 2, 3]
+    assert len(report["trace_ids"]) == 1  # one run, one identity
+    events = json.load(open(out))["traceEvents"]
+    pids = {e["pid"] for e in events if e.get("ph") == "X"}
+    assert pids == {0, 1, 2, 3}  # the killed rank's partial stream too
+    # server spans parent under the correct worker step spans
+    span_ids = {e["args"]["span_id"]: e for e in events
+                if e.get("ph") == "X" and "span_id" in e.get("args", {})
+                and e["args"]["span_id"]}
+    server = [e for e in events if e.get("cat") == "kvstore_server"]
+    assert server and report["orphan_server_spans"] == 0
+    # server-span emission is gated on an open worker step, so the killed
+    # rank's zombie pushes (no span) produce nothing and every emitted
+    # server span parents under the right step of the right rank
+    parented = [e for e in server if e["args"]["parent"] is not None]
+    assert parented == server
+    for e in parented:
+        parent = span_ids[e["args"]["parent"]]
+        assert parent["pid"] == e["args"]["origin_rank"] == e["pid"]
+        # ...and under the matching step, not just the matching rank
+        assert f"-s{parent['args']['step']}" in e["args"]["parent"]
+
+    # -- the straggler detector names the injected slow rank ------------------
+    srep = telemetry.detect_stragglers(
+        telemetry.load_rank_streams(paths))
+    flagged = {s["rank"]: s for s in srep["stragglers"]}
+    assert slow in flagged, srep
+    assert flagged[slow]["blame"] == "device", srep
+    assert srep["skew_seconds"] > 0.01  # ~46ms/step injected skew
+    # the skew gauge was published back through the hub
+    assert ('mxtpu_skew_seconds' in telemetry.prom_dump())
+
+    # the CLI front door agrees
+    rc = telemetry_cli(["merge", *paths, "-o",
+                        str(tmp_path / "fleet2.json")])
+    assert rc == 0
+
+
+# -- diff CI gate --------------------------------------------------------------
+
+def _write_run(path, step_ms, mfu):
+    tl_events = []
+    for i in range(20):
+        tl_events.append({"v": 2, "kind": "span", "ts": float(i),
+                          "rank": 0, "world_size": 1, "name": "step",
+                          "epoch": 0, "step": i, "dur_ms": step_ms,
+                          "phases": [], "trace_id": "t", "span_id": f"s{i}",
+                          "wall_ts": float(i)})
+    tl_events.append({"v": 2, "kind": "epoch_summary", "ts": 21.0,
+                      "rank": 0, "world_size": 1, "epoch": 0, "steps": 20,
+                      "seconds": 1.0, "mfu_pct": mfu, "goodput_pct": 90.0})
+    with open(path, "w") as f:
+        for e in tl_events:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_diff_cli_perf_gate(tmp_path, capsys):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_run(a, step_ms=10.0, mfu=40.0)
+    _write_run(b, step_ms=10.4, mfu=39.5)        # within 10%
+    assert telemetry_cli(["diff", a, b]) == 0
+    _write_run(b, step_ms=13.0, mfu=40.0)        # 30% step-time regression
+    assert telemetry_cli(["diff", a, b]) == 3
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out
+    # tighter threshold catches the small slip too; MFU drops regress
+    _write_run(b, step_ms=10.4, mfu=30.0)
+    assert telemetry_cli(["diff", a, b, "--threshold", "3"]) == 3
+    # improvement is never a regression
+    _write_run(b, step_ms=8.0, mfu=50.0)
+    assert telemetry_cli(["diff", a, b]) == 0
+
+
+def test_flight_cli_show_and_validate(tmp_path, capsys):
+    tl = telemetry.StepTimeline()
+    with tl.begin_step(0, 0) as span:
+        span.mark("device")
+        span.event("step_retry")
+    path = str(tmp_path / "f.json")
+    flight.dump(path, reason="unit")
+    assert telemetry_cli(["flight", "validate", path]) == 0
+    assert telemetry_cli(["flight", "show", path]) == 0
+    out = capsys.readouterr().out
+    assert "reason=unit" in out and "step_retry" in out
+    # corrupted dump: nonzero exit
+    blob = json.load(open(path))
+    blob["crc32"] ^= 1
+    json.dump(blob, open(path, "w"))
+    assert telemetry_cli(["flight", "validate", path]) == 3
+
+
+# -- tracing stays compile-clean -----------------------------------------------
+
+def test_zero_recompile_armed_epoch_with_tracing(tmp_path, monkeypatch):
+    """ISSUE 6 acceptance: the zero-recompile armed epoch stays green with
+    tracing + flight recording enabled (identity stamping and ring writes
+    are host-side; nothing leaks into jit cache keys)."""
+    from mxnet_tpu.utils import compile as cm
+
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 8).astype(np.float32)
+    y = rng.randint(0, 4, (128,)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        data, name="fc", num_hidden=4), name="softmax")
+    model = mx.FeedForward(out, ctx=mx.cpu(), num_epoch=3,
+                           learning_rate=0.1)
+    tracker = cm.RecompileTracker(raise_on_recompile=True)
+
+    def arm_after_first(epoch, *_):
+        if epoch == 0:
+            tracker.arm()
+
+    try:
+        model.fit(X, y, batch_size=32, telemetry=True,
+                  epoch_end_callback=arm_after_first)
+    finally:
+        tracker.disarm()
+    assert tracker.recompiles == []
+    assert len(model.telemetry.steps("step")) == 12
