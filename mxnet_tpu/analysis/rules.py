@@ -171,6 +171,19 @@ register_rule(
     "nothing")
 
 register_rule(
+    "MX308", "warning",
+    "wire collective in comm/ not pinned by optimization_barrier on both "
+    "sides: converting before/after pure data movement is elementwise-"
+    "equivalent, so XLA commutes the encode/decode casts across the "
+    "collective and the payload crosses the wire at full precision — "
+    "correct values, compression silently lost (the convert-commuting "
+    "bug class documented at comm/allreduce.py _exchange: the bf16 "
+    "all-gather observed lowering as f32)",
+    "bracket the collective's payload with lax.optimization_barrier "
+    "immediately before AND after the wire op (see comm/allreduce.py "
+    "_exchange for the canonical shape)")
+
+register_rule(
     "MX306", "warning",
     "un-barriered wall-clock delta around device dispatch: a "
     "time.time()/perf_counter() start/stop pair with work between and no "

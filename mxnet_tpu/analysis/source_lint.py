@@ -11,6 +11,10 @@ Catches, before anything imports or traces:
   MX306        un-barriered wall-clock deltas around device dispatch
                (timing the enqueue instead of the execution; telemetry/
                and utils/profiler are the sanctioned timing homes),
+  MX308        wire collectives in comm/ without optimization_barrier
+               pinning on both sides (XLA commutes the encode/decode
+               converts across the collective: fp32 on the wire,
+               compression silently lost),
   MX601-602    robustness hazards (bare ``except:``; ``while True`` retry
                loops that swallow exceptions with no backoff/deadline —
                the loop shape that melts a parameter server under a
@@ -659,6 +663,70 @@ def _scan_leaked_spans(tree, path, findings):
                 path=path, line=lineno, col=col))
 
 
+# -- MX308: unpinned wire collectives in comm/ --------------------------------
+# The convert-commuting bug class documented at comm/allreduce.py
+# (_exchange): converting before/after pure data movement is elementwise-
+# equivalent, so XLA freely commutes the encode/decode casts across a
+# collective — the payload then crosses the wire at full precision with
+# correct values and the compression silently lost. Every wire collective
+# in comm/ must be bracketed by lax.optimization_barrier. The scan is
+# function-local and zero-FP-biased: a collective call is flagged only
+# when NO optimization_barrier call appears lexically before it, or none
+# after it, within the same function (nested defs are their own scope).
+
+_WIRE_COLLECTIVES = ("all_to_all", "all_gather", "psum_scatter")
+
+
+def _comm_scoped(path: str) -> bool:
+    return "comm" in os.path.normpath(path).split(os.sep)
+
+
+def _iter_local_nodes(fn):
+    """Walk a scope's body without descending into nested defs/lambdas
+    (every def, lambda, and the module itself is scanned as its own
+    scope by _scan_unpinned_collectives)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scan_unpinned_collectives(tree, path, findings):
+    if not _comm_scoped(path):
+        return
+    # every scope that can hold a collective call: defs, lambdas, and
+    # module level — a collective is only excused by barriers in its OWN
+    # scope, so a bare lambda or module-level call can't hide
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef, ast.Lambda))]
+    for fn in scopes:
+        colls, barriers = [], []
+        for sub in _iter_local_nodes(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = sub.func.attr if isinstance(sub.func, ast.Attribute) \
+                else getattr(sub.func, "id", None)
+            if name in _WIRE_COLLECTIVES:
+                colls.append((name, sub.lineno, sub.col_offset))
+            elif name == "optimization_barrier":
+                barriers.append(sub.lineno)
+        for name, lineno, col in colls:
+            pinned = any(ln <= lineno for ln in barriers) and \
+                any(ln >= lineno for ln in barriers)
+            if not pinned:
+                findings.append(Finding(
+                    get_rule("MX308"),
+                    f"`{name}` has no optimization_barrier pinning on both "
+                    "sides — XLA can commute the payload converts across "
+                    "the collective (fp32 on the wire, compression lost)",
+                    path=path, line=lineno, col=col))
+
+
 # calls whose presence inside a retry loop counts as bounding it: anything
 # sleep/backoff/wait-shaped (time.sleep, policy backoff, cv.wait_for, ...)
 _BOUNDING_CALL_PARTS = ("sleep", "backoff", "wait", "delay", "retry_call",
@@ -762,6 +830,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     _scan_robustness(tree, path, scan.findings)
     _scan_unbarriered_timing(tree, path, scan.imports, scan.findings)
     _scan_leaked_spans(tree, path, scan.findings)
+    _scan_unpinned_collectives(tree, path, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)
     roots += [d for d in scan.defs if d.name in scan.traced_names]

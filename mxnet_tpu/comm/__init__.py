@@ -14,12 +14,18 @@ raw psums over gradients elsewhere):
                   train-step carry
   bucketing.py    DDP-style size-capped fused slabs + host codec for the
                   kvstore transports
-  stats.py        exact wire-byte plans, the process CommRegistry behind
-                  ``comm_stats()``, and compiled-HLO collective extraction
+  overlap.py      comm/compute overlap scheduler: reverse-topological
+                  per-bucket sync inside the jit (each slab's quantized
+                  reduce-scatter/all-gather pair rides under the rest of
+                  backward) + per-bucket error-feedback residuals
+  stats.py        exact wire-byte plans (fused and per-bucket overlapped),
+                  the process CommRegistry behind ``comm_stats()``, and
+                  compiled-HLO collective extraction
 
-Entry points: ``FeedForward.fit(compression=...)``,
-``parallel.make_data_parallel_step(compression=...)``,
+Entry points: ``FeedForward.fit(compression=..., overlap=...)``,
+``parallel.make_data_parallel_step(compression=..., overlap=...)``,
 ``KVStore.set_gradient_compression(...)`` (the reference kvstore API),
+``AsyncKVStore.push_pull_stale`` (stale-sync pipelining),
 ``comm.comm_stats()``. Guide: doc/developer-guide/comm.md.
 """
 
@@ -29,9 +35,14 @@ from .allreduce import (compressed_allreduce, error_feedback_allreduce,
                         init_error_feedback, flat_size, padded_flat_size)
 from .bucketing import (DEFAULT_BUCKET_BYTES, GradBucketer, HostCodec,
                         decode_payload)
+from .overlap import (OverlapConfig, OverlapPlan, fused_layout_key,
+                      init_overlap_residuals, overlap_allreduce,
+                      overlap_efficiency, plan_overlap,
+                      residuals_match_plan, reverse_topo_param_order)
 from .stats import (CommRegistry, allreduce_plan, comm_stats,
                     fp32_allreduce_wire_bytes, hlo_collective_table,
-                    hlo_collective_wire_bytes, registry, reset_comm_stats)
+                    hlo_collective_wire_bytes, overlap_plan, registry,
+                    reset_comm_stats)
 
 __all__ = [
     "CompressionSpec", "encode", "decode", "payload_nbytes",
@@ -39,7 +50,10 @@ __all__ = [
     "compressed_allreduce", "error_feedback_allreduce",
     "init_error_feedback", "flat_size", "padded_flat_size",
     "GradBucketer", "HostCodec", "decode_payload", "DEFAULT_BUCKET_BYTES",
+    "OverlapConfig", "OverlapPlan", "plan_overlap", "overlap_allreduce",
+    "init_overlap_residuals", "residuals_match_plan",
+    "reverse_topo_param_order", "fused_layout_key", "overlap_efficiency",
     "CommRegistry", "registry", "comm_stats", "reset_comm_stats",
-    "allreduce_plan", "fp32_allreduce_wire_bytes",
+    "allreduce_plan", "overlap_plan", "fp32_allreduce_wire_bytes",
     "hlo_collective_table", "hlo_collective_wire_bytes",
 ]

@@ -145,6 +145,13 @@ def compressed_allreduce(tree, compression=None, axis_name="dp",
         raise MXNetError("compressed_allreduce needs axis_size= (the data-"
                          "axis extent; reshapes need a static device count)")
     axis_size = int(axis_size)
+    if axis_size == 1:
+        # degenerate single-device mesh: the sum over one device is the
+        # device's own gradient — encode/all_to_all/all_gather would move
+        # zero wire bytes (the plan already prices it at 0) while paying
+        # the full quantization arithmetic AND injecting quantization
+        # error for nothing. No-op sync instead.
+        return tree
     flat, meta = _flatten(tree)
     flat, L = _pad_flat(flat, spec, axis_size)
     out, *_ = _exchange(flat, spec, axis_name, axis_size)
@@ -171,6 +178,10 @@ def error_feedback_allreduce(tree, residual, compression, axis_name="dp",
     if axis_size is None:
         raise MXNetError("error_feedback_allreduce needs axis_size=")
     axis_size = int(axis_size)
+    if axis_size == 1:
+        # single-device mesh: no wire, no quantization, no error to feed
+        # back — the residual passes through untouched (stays zero)
+        return tree, residual
     flat, meta = _flatten(tree)
     L = flat.shape[0]
     Lp = padded_flat_size(L, spec, axis_size)

@@ -28,6 +28,18 @@ __all__ = ["GradBucketer", "HostCodec", "DEFAULT_BUCKET_BYTES"]
 DEFAULT_BUCKET_BYTES = 4 << 20  # 4 MB of f32, the DDP default
 
 
+def _new_bucket(name):
+    return {"name": name, "keys": [], "shapes": [], "offsets": [],
+            "size": 0}
+
+
+def _append_key(bucket, key, shape):
+    bucket["keys"].append(key)
+    bucket["shapes"].append(tuple(int(d) for d in shape))
+    bucket["offsets"].append(bucket["size"])
+    bucket["size"] += int(np.prod(shape)) if shape else 1
+
+
 class GradBucketer:
     """Partition a keyed gradient set into size-capped fused flat slabs.
 
@@ -50,13 +62,12 @@ class GradBucketer:
             size = int(np.prod(shape)) if shape else 1
             if cur is None or (cur["size"] and
                                4 * (cur["size"] + size) > self.max_bytes):
-                cur = {"name": f"bucket{len(self.buckets)}", "keys": [],
-                       "shapes": [], "offsets": [], "size": 0}
+                cur = _new_bucket(f"bucket{len(self.buckets)}")
                 self.buckets.append(cur)
-            cur["keys"].append(key)
-            cur["shapes"].append(tuple(int(d) for d in shape))
-            cur["offsets"].append(cur["size"])
-            cur["size"] += size
+            _append_key(cur, key, shape)
+        self._index()
+
+    def _index(self):
         self._by_key = {k: (b, i) for b in self.buckets
                         for i, k in enumerate(b["keys"])}
 
@@ -75,22 +86,22 @@ class GradBucketer:
 
     @classmethod
     def from_layout(cls, layout):
-        shapes = [(k, s) for _, pairs in layout for k, s in pairs]
-        out = cls(shapes, max_bytes=1 << 62)  # one bucket...
-        # ...unless the layout says otherwise: rebuild exactly as given
+        """Rebuild the EXACT layout the peer serialized — bucket names and
+        key->slab assignment as given, no re-derivation (the cap that
+        produced them lives with the producer; ``max_bytes`` here is only
+        the reconstructed layout's actual largest slab)."""
+        if not layout:
+            raise MXNetError("GradBucketer.from_layout needs a non-empty "
+                             "layout")
+        out = cls.__new__(cls)
         out.buckets = []
         for name, pairs in layout:
-            b = {"name": name, "keys": [], "shapes": [], "offsets": [],
-                 "size": 0}
+            b = _new_bucket(name)
             for k, s in pairs:
-                size = int(np.prod(s)) if s else 1
-                b["keys"].append(k)
-                b["shapes"].append(tuple(int(d) for d in s))
-                b["offsets"].append(b["size"])
-                b["size"] += size
+                _append_key(b, k, s)
             out.buckets.append(b)
-        out._by_key = {k: (b, i) for b in out.buckets
-                       for i, k in enumerate(b["keys"])}
+        out.max_bytes = max(4 * b["size"] for b in out.buckets)
+        out._index()
         return out
 
     def pack(self, kvs: dict) -> dict:
@@ -121,13 +132,21 @@ class GradBucketer:
 
 def decode_payload(compression, payload: dict) -> np.ndarray:
     """Decode one host payload (as produced by :meth:`HostCodec.encode`)
-    without codec state — the receiving end of a kvstore transport."""
+    without codec state — the receiving end of a kvstore transport.
+
+    Symmetric wire accounting: the encoder records *sent* bytes into the
+    comm registry; this (the one shared decode path — the servers and
+    ``HostCodec.decode`` all land here) records the same payload as
+    *received*, so ``comm_stats()`` sees both ends of every transport."""
     spec = CompressionSpec.resolve(compression)
     if spec is None:
         raise MXNetError("decode_payload needs an active compression mode")
     n = int(payload["_n"])
     flat = decode(spec, {k: v for k, v in payload.items() if k != "_n"},
                   xp=np)
+    from .stats import registry
+
+    registry().record_host_bytes(received=payload_bytes_of(payload))
     return np.asarray(flat, np.float32).ravel()[:n]
 
 
@@ -145,6 +164,7 @@ class HostCodec:
         self._residual: dict = {}   # slab name -> np residual
         self.bytes_encoded = 0      # payload bytes produced
         self.bytes_raw = 0          # f32 bytes the payloads replaced
+        self.bytes_decoded = 0      # payload bytes consumed (received end)
 
     def _pad(self, flat):
         unit = quantization_unit(self.spec)
@@ -185,6 +205,7 @@ class HostCodec:
         self._residual.clear()
 
     def decode(self, payload: dict) -> np.ndarray:
+        self.bytes_decoded += payload_bytes_of(payload)
         return decode_payload(self.spec, payload)
 
     @property

@@ -34,7 +34,7 @@ import threading
 
 from .compression import CompressionSpec, payload_nbytes, quantization_unit
 
-__all__ = ["allreduce_plan", "fp32_allreduce_wire_bytes",
+__all__ = ["allreduce_plan", "overlap_plan", "fp32_allreduce_wire_bytes",
            "CommRegistry", "registry", "comm_stats", "reset_comm_stats",
            "hlo_collective_table", "hlo_collective_wire_bytes"]
 
@@ -85,6 +85,66 @@ def allreduce_plan(num_elements: int, axis_size: int,
         "collectives": rows, "payload_bytes": payload, "wire_bytes": wire,
         "fp32_wire_bytes": fp32_wire,
         "ratio": fp32_wire / wire if wire else float("inf"),
+    }
+
+
+def overlap_plan(bucket_elems, axis_size, compression=None) -> dict:
+    """Exact per-step comm plan for an overlapped per-bucket schedule.
+
+    ``bucket_elems``: ``[(bucket_name, num_elements), ...]`` in schedule
+    order (``OverlapPlan.bucket_elems()``). Each bucket gets its own
+    closed-form :func:`allreduce_plan`; the merged totals are computed
+    from the SUMMED integer payload bytes, and because payload bytes are
+    linear in the padded length, they equal — exactly, not approximately —
+    the fused single-bucket plan over the same padded total
+    (``fused_wire_bytes`` / ``matches_fused``). The overlapped schedule
+    therefore moves the same bytes as the fused one plus only the
+    per-bucket padding slack, which ``padded_elements - num_elements``
+    prices explicitly.
+    """
+    n = int(axis_size)
+    spec = CompressionSpec.resolve(compression)
+    buckets = []
+    for name, num in bucket_elems:
+        p = allreduce_plan(num, n, spec)
+        buckets.append({"bucket": name, **p})
+    # merge rows by opcode, summing the integer payloads first and applying
+    # the wire factor to the SUM — float-exact against the fused plan
+    merged: dict[str, dict] = {}
+    for b in buckets:
+        for r in b["collectives"]:
+            row = merged.setdefault(r["op"], {"op": r["op"], "count": 0,
+                                              "payload_bytes": 0})
+            row["count"] += r["count"]
+            row["payload_bytes"] += r["payload_bytes"]
+    raw_total = sum(int(num) for _, num in bucket_elems)
+    if spec is None:
+        padded_total = raw_total
+        for row in merged.values():
+            row["wire_bytes"] = 2.0 * (n - 1) / n * row["payload_bytes"]
+    else:
+        unit = quantization_unit(spec) * n
+        padded_total = sum(-(-int(num) // unit) * unit
+                           for _, num in bucket_elems)
+        # both compressed rows carry wire = (n-1)/n x payload (the
+        # all-gather payload is already the full gathered buffer), so the
+        # factor applies uniformly to the integer payload sums
+        for row in merged.values():
+            row["wire_bytes"] = (n - 1) / n * row["payload_bytes"]
+    rows = sorted(merged.values(), key=lambda r: r["op"])
+    payload = sum(r["payload_bytes"] for r in rows)
+    wire = sum(r["wire_bytes"] for r in rows)
+    fused = allreduce_plan(padded_total, n, spec)
+    fp32_wire = fp32_allreduce_wire_bytes(raw_total, n)
+    return {
+        "mode": "none" if spec is None else spec.mode,
+        "num_elements": raw_total, "padded_elements": padded_total,
+        "axis_size": n, "num_buckets": len(buckets), "buckets": buckets,
+        "collectives": rows, "payload_bytes": payload, "wire_bytes": wire,
+        "fp32_wire_bytes": fp32_wire,
+        "ratio": fp32_wire / wire if wire else float("inf"),
+        "fused_wire_bytes": fused["wire_bytes"],
+        "matches_fused": wire == fused["wire_bytes"],
     }
 
 

@@ -50,6 +50,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -552,6 +553,8 @@ class AsyncKVStore(KVStore):
         self._codec = None         # HostCodec for compressed pushes
         self._bucketer = None      # (key tuple, bucketer, layout, hash)
         self._layouts_sent: set = set()  # layout hashes the server holds
+        self._stale_round = None   # in-flight push_pull future (stale sync)
+        self._stale_pool = None    # lazy single background pusher thread
         self._sync_trace_identity()
 
     def _sync_trace_identity(self):
@@ -812,6 +815,77 @@ class AsyncKVStore(KVStore):
                               {k: np.asarray(v, np.float32)
                                for k, v in kvs.items()}, mutating=True)
 
+    # -- stale-sync pipelining (comm/compute overlap on the kvstore path) ------
+    def _submit_stale(self, kvs):
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._stale_pool is None:
+            # ONE background pusher: rounds stay ordered, and the socket
+            # lock in _call serializes it against foreground traffic
+            self._stale_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="mxtpu-stale-push")
+
+        def round_trip():
+            t0 = time.perf_counter()
+            out = self.push_pull(kvs)
+            return out, t0, time.perf_counter()
+
+        return self._stale_pool.submit(round_trip)
+
+    def push_pull_stale(self, kvs: dict) -> dict:
+        """Pipelined parameter-host sync: ``overlap=`` on dist_async.
+
+        This step's grads go on the wire from a background thread while
+        the NEXT step computes; the weights returned are the result of the
+        PREVIOUS round's push — one round stale (the ps-lite async
+        contract, with the staleness bounded at exactly 1 by construction:
+        only one round is ever in flight). The call blocks only on the
+        un-hidden tail of the previous round; the hidden portion is
+        recorded as an ``overlap`` sub-span on the current step span and a
+        ``comm_overlap_hidden_seconds`` histogram, so the timeline's
+        ``wire`` phase shows exactly what the pipeline failed to hide.
+
+        First call (no round in flight): pulls current weights
+        synchronously — staleness starts at the second step. Drain with
+        :meth:`flush_stale` before anything reads weights as truth
+        (checkpoints, epoch callbacks, evaluation).
+        """
+        from . import telemetry
+
+        prev, self._stale_round = self._stale_round, None
+        snap = {k: np.asarray(v, np.float32) for k, v in kvs.items()}
+        if prev is None:
+            out = self.pull_many(list(snap))
+            self._stale_round = self._submit_stale(snap)
+            return out
+        t_wait0 = time.perf_counter()
+        out, t0, t1 = prev.result()
+        wait = time.perf_counter() - t_wait0
+        hidden = max((t1 - t0) - wait, 0.0)
+        h = telemetry.hub()
+        h.observe("comm_stale_wire_wait_seconds", wait)
+        h.observe("comm_overlap_hidden_seconds", hidden)
+        span = telemetry.current_span()
+        if span is not None and hidden > 0.0:
+            # the round started during the PREVIOUS step's span; clamp the
+            # sub into this span (duration is the meaningful quantity —
+            # an unclamped start would render as a negative rel_ms child)
+            span.add_sub("overlap", max(t0, span.start), hidden)
+        self._stale_round = self._submit_stale(snap)
+        return out
+
+    def flush_stale(self, keys) -> dict:
+        """Drain the stale pipeline and return fresh weights.
+
+        Waits out any in-flight round (its push must land — dropping it
+        would lose a step's gradients), then pulls current values for
+        ``keys``. The epoch-boundary / guard-trip / checkpoint barrier of
+        the stale-sync mode."""
+        fut, self._stale_round = self._stale_round, None
+        if fut is not None:
+            fut.result()
+        return self.pull_many(list(keys))
+
     def compression_stats(self) -> dict:
         """Client-side wire accounting for the compressed push path."""
         if self._codec is None:
@@ -856,6 +930,9 @@ class AsyncKVStore(KVStore):
 
     def __del__(self):
         try:
+            if self._stale_pool is not None:
+                # let any in-flight stale round finish before the socket dies
+                self._stale_pool.shutdown(wait=True)
             self._call("stop", retry=False, timeout=5.0)
             self._sock.close()
         except Exception:  # interpreter teardown

@@ -498,7 +498,7 @@ class FeedForward(BASE_ESTIMATOR):
 
     def _get_train_step(self, bucket_key, data_names, label_names, optimizer,
                         mesh, metric=None, apply_update=True, guard_cfg=None,
-                        pad_policy=None, compression=None):
+                        pad_policy=None, compression=None, overlap_plan=None):
         """The fused train step for one program configuration, built once
         and cached on the instance (reference analog: GraphExecutor's
         cached engine ops, one per shape). precompile() populates the same
@@ -509,6 +509,7 @@ class FeedForward(BASE_ESTIMATOR):
                None if guard_cfg is None else repr(vars(guard_cfg)),
                None if pad_policy is None else pad_policy.key(),
                None if compression is None else compression.key(),
+               None if overlap_plan is None else overlap_plan.layout_key(),
                str(self.compute_dtype))
         if key not in self._train_fns:
             warmed = sum(getattr(fn, "_tracked", None) is not None
@@ -529,13 +530,14 @@ class FeedForward(BASE_ESTIMATOR):
                 symbol=self._symbol_for_bucket(bucket_key),
                 metric_update=None if metric is None else metric.device_update,
                 apply_update=apply_update, guard_cfg=guard_cfg,
-                pad_policy=pad_policy, compression=compression, label=label)
+                pad_policy=pad_policy, compression=compression,
+                overlap_plan=overlap_plan, label=label)
         return self._train_fns[key]
 
     def _build_train_step(self, data_names, label_names, optimizer, mesh,
                           symbol=None, metric_update=None, apply_update=True,
                           guard_cfg=None, pad_policy=None, compression=None,
-                          label=None):
+                          overlap_plan=None, label=None):
         """Compile the fused train step.
 
         With ``guard_cfg`` (resilience.GuardConfig) the program additionally
@@ -563,6 +565,13 @@ class FeedForward(BASE_ESTIMATOR):
         aux updates are psum/pmean'd so the fused device metric and
         BatchNorm statistics stay global. Donation and the zero-recompile
         steady-state invariant are preserved (tests/test_comm.py).
+
+        With ``overlap_plan`` (comm.OverlapPlan) the gradient sync emits
+        one independent quantized reduce-scatter/all-gather pair PER
+        BUCKET in reverse-topological order instead of one fused pair, so
+        XLA can hide each bucket's wire time under the rest of backward;
+        the comm state becomes a dict of per-bucket residual ledgers
+        (doc/developer-guide/comm.md, "Overlap scheduler").
         """
         graph_fn = _build_graph_fn(symbol if symbol is not None else self.symbol,
                                    is_train=True)
@@ -611,7 +620,13 @@ class FeedForward(BASE_ESTIMATOR):
                 # explicit gradient sync (sum semantics, matching the
                 # partitioner-inserted psum; the optimizer's rescale_grad
                 # turns the sum into the mean)
-                if has_cstate:
+                if overlap_plan is not None:
+                    grads, resid = comm_mod.overlap_allreduce(
+                        grads, cstate["resid"] if has_cstate else None,
+                        overlap_plan, axis_name="dp", average=False)
+                    if has_cstate:
+                        new_cstate = {"resid": resid}
+                elif has_cstate:
                     grads, resid = comm_mod.error_feedback_allreduce(
                         grads, cstate["resid"], comm_spec, axis_name="dp",
                         axis_size=axis_size, average=False)
@@ -688,7 +703,7 @@ class FeedForward(BASE_ESTIMATOR):
         if in_shard:
             return self._finish_sharded_step(
                 compute, mesh, comm_spec, axis_size, guard_cfg, has_cstate,
-                padded, label)
+                padded, label, overlap_plan=overlap_plan)
         if guard_cfg is None:
             if padded:
                 def step(params, opt_state, aux, batch, rng, lr, mstate,
@@ -779,7 +794,8 @@ class FeedForward(BASE_ESTIMATOR):
         return run
 
     def _finish_sharded_step(self, compute, mesh, comm_spec, axis_size,
-                             guard_cfg, has_cstate, padded, label):
+                             guard_cfg, has_cstate, padded, label,
+                             overlap_plan=None):
         """Assemble the compressed-comm train step: ``jit(shard_map(...))``
         over the dp axis (see _build_train_step's compression note).
 
@@ -832,8 +848,11 @@ class FeedForward(BASE_ESTIMATOR):
 
         def run(params, opt_state, aux, batch, rng, lr, mstate, *rest):
             if not plan_state["registered"]:
-                reg.register_plan(label, comm_mod.allreduce_plan(
-                    comm_mod.flat_size(params), axis_size, comm_spec))
+                reg.register_plan(
+                    label,
+                    overlap_plan.wire_plan() if overlap_plan is not None
+                    else comm_mod.allreduce_plan(
+                        comm_mod.flat_size(params), axis_size, comm_spec))
                 plan_state["registered"] = True
             reg.record_step(label)
             batch = {k: _place(v, batch_sh if np.ndim(v) else repl)
@@ -891,7 +910,7 @@ class FeedForward(BASE_ESTIMATOR):
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, batch_size=128,
             sharded_checkpoint_dir=None, guards=None, pad_policy=None,
-            compression=None, telemetry=None):
+            compression=None, overlap=None, telemetry=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -933,6 +952,22 @@ class FeedForward(BASE_ESTIMATOR):
         quantized. Wire accounting: ``comm.comm_stats()`` and the
         per-epoch ``Comm:`` log line (doc/developer-guide/comm.md).
 
+        ``overlap``: comm/compute overlap control — None (default; env
+        gate ``MXNET_TPU_COMM_OVERLAP``), True (4 MB buckets), an int
+        bucket byte cap, or a comm.OverlapConfig. On the mesh path (needs
+        ``compression``) the fused step syncs one independent quantized
+        reduce-scatter/all-gather pair per gradient bucket, scheduled in
+        reverse-topological order so XLA hides wire time under backward;
+        error-feedback residuals become per-bucket ledgers (checkpointed
+        with the optimizer state, invalidated when the bucket plan
+        changes). With kvstore='dist_async' it arms STALE-SYNC pipelining:
+        each step's push+pull runs on a background thread and the step
+        trains on weights one round stale — the timeline's ``wire`` phase
+        shows only the un-hidden tail, the hidden portion lands as an
+        ``overlap`` sub-span, and ``comm_overlap_efficiency`` gauges how
+        much of the wire was hidden (doc/developer-guide/comm.md,
+        "Overlap scheduler").
+
         ``telemetry``: observability control — None (default; env gate
         ``MXNET_TPU_TELEMETRY``), True, a JSONL path, or a
         telemetry.TelemetryConfig. When on, the loop records a
@@ -954,8 +989,10 @@ class FeedForward(BASE_ESTIMATOR):
         from . import comm as comm_mod
 
         comm_spec = comm_mod.CompressionSpec.resolve(compression)
+        overlap_cfg = comm_mod.OverlapConfig.resolve(overlap)
         resume_opt_leaves, resume_num_update = None, 0
         resume_scale = None
+        resume_comm_state, resume_comm_layout = None, None
         if sharded_checkpoint_dir is not None:
             from .utils import checkpoint as ckpt_mod
 
@@ -964,8 +1001,10 @@ class FeedForward(BASE_ESTIMATOR):
                 # FeedForward keeps params replicated (dp training), so the
                 # host-numpy restore is the right cost here; mesh-sharded
                 # restore stays available via utils.checkpoint directly.
-                loaded, laux, _, meta, resume_opt_leaves = \
-                    ckpt_mod.load_sharded(sharded_checkpoint_dir, last)
+                loaded, laux, _, meta, resume_opt_leaves, \
+                    resume_comm_state = ckpt_mod.load_sharded(
+                        sharded_checkpoint_dir, last, with_comm=True)
+                resume_comm_layout = meta.get("comm_layout")
                 self.arg_params = {k: NDArray(np.asarray(v))
                                    for k, v in loaded.items()}
                 self.aux_params = {k: NDArray(np.asarray(v))
@@ -1037,6 +1076,35 @@ class FeedForward(BASE_ESTIMATOR):
                         comm_spec.mode)
             comm_spec = None
 
+        # overlap= resolves per path: dist_async -> stale-sync pipelining
+        # (pushes lag one step behind compute); mesh + compression -> the
+        # in-jit per-bucket schedule; anything else has no wire to hide
+        stale_sync = False
+        if overlap_cfg is not None and async_kv:
+            if hasattr(kv, "push_pull_stale"):
+                stale_sync = True
+                logger.info("overlap: stale-sync armed — bucket pushes lag "
+                            "one step behind compute (weights one round "
+                            "stale; ps-lite async heritage)")
+            overlap_cfg = None
+        elif overlap_cfg is not None and comm_spec is None:
+            if mesh is not None:
+                logger.info("overlap= ignored: the overlapped schedule "
+                            "pipelines the quantized per-bucket sync — set "
+                            "compression= to arm it")
+            overlap_cfg = None
+        overlap_plan = None
+        if overlap_cfg is not None:
+            overlap_plan = comm_mod.plan_overlap(
+                {k: tuple(self.arg_params[k].shape) for k in param_names},
+                comm_spec, int(mesh.shape["dp"]),
+                max_bytes=overlap_cfg.bucket_bytes, symbol=self.symbol)
+            logger.info(
+                "overlap: %d bucket(s) scheduled reverse-topologically "
+                "(cap %d bytes; per-bucket reduce-scatter/all-gather "
+                "rides under backward)", overlap_plan.num_buckets,
+                overlap_cfg.bucket_bytes)
+
         if async_kv:
             if sharded_checkpoint_dir is not None and num_workers > 1:
                 # single-worker dist_async (one replica, one writer) is
@@ -1078,11 +1146,47 @@ class FeedForward(BASE_ESTIMATOR):
 
         # error-feedback comm state: per-device quantization residuals,
         # row-sharded so each device carries only its own error (threaded
-        # and donated through the step exactly like the guard state)
+        # and donated through the step exactly like the guard state).
+        # Under the overlap schedule this is a dict of per-bucket ledgers;
+        # either shape is checkpointed with a layout key, and a resumed
+        # run only reuses saved residuals that still describe its buckets.
         cstate = None
+        resid_layout_key = None
         if comm_spec is not None and comm_spec.error_feedback:
-            resid = optimizer.init_comm_residual(
-                params, comm_spec, int(mesh.shape["dp"]))
+            ndev = int(mesh.shape["dp"])
+            if overlap_plan is not None:
+                resid = comm_mod.init_overlap_residuals(overlap_plan)
+                resid_layout_key = overlap_plan.layout_key()
+                if resume_comm_state is not None:
+                    if resume_comm_layout == resid_layout_key and \
+                            comm_mod.residuals_match_plan(resume_comm_state,
+                                                          overlap_plan):
+                        resid = {k: jnp.asarray(v)
+                                 for k, v in resume_comm_state.items()}
+                        logger.info("resumed %d per-bucket EF residual "
+                                    "ledger(s)", len(resid))
+                    else:
+                        logger.info(
+                            "EF residuals dropped on resume: bucket plan "
+                            "changed (%s -> %s); starting a fresh ledger",
+                            resume_comm_layout, resid_layout_key)
+            else:
+                resid = optimizer.init_comm_residual(
+                    params, comm_spec, ndev)
+                resid_layout_key = comm_mod.fused_layout_key(
+                    comm_mod.flat_size(params), comm_spec, ndev)
+                if resume_comm_state is not None:
+                    saved = resume_comm_state.get("__fused__")
+                    if resume_comm_layout == resid_layout_key and \
+                            saved is not None and \
+                            tuple(saved.shape) == tuple(resid.shape):
+                        resid = jnp.asarray(saved)
+                        logger.info("resumed fused EF residual")
+                    else:
+                        logger.info(
+                            "EF residual dropped on resume: layout changed "
+                            "(%s -> %s)", resume_comm_layout,
+                            resid_layout_key)
             cstate = {"resid": jax.device_put(
                 resid, NamedSharding(mesh, P("dp")))}
 
@@ -1202,22 +1306,42 @@ class FeedForward(BASE_ESTIMATOR):
             return {"loss_scale": float(np.asarray(_host_local(
                 gstate["scale"])))}
 
+        def _comm_ckpt():
+            """(comm_state, meta) for save_sharded: the live EF residual
+            ledger(s) plus the layout key resume validates against."""
+            if cstate is None:
+                return None, {}
+            r = cstate["resid"]
+            state = dict(r) if isinstance(r, dict) else {"__fused__": r}
+            return state, {"comm_layout": resid_layout_key}
+
         def _preempt_flush():
             """SIGTERM landed: flush the live state as checkpoint ``epoch``
             (meta epoch = the in-progress epoch, which the relaunch redoes
             from its start — epoch-granular resume, same as the reference's
             per-epoch do_checkpoint) and stop via TrainingPreempted."""
+            nonlocal params
+            if stale_sync:
+                # drain the pipelined push first: a round may be in flight
+                # one step behind compute, and the checkpoint must not save
+                # round-stale weights (push_pull_stale's contract; a drain
+                # with nothing in flight is a plain pull)
+                pulled = kv.flush_stale(param_names)
+                params = {k: jnp.asarray(pulled[k]) for k in param_names}
             if sharded_checkpoint_dir is not None:
                 from .utils import checkpoint as ckpt_mod
 
                 # flush points sit at step boundaries, where the params
                 # pytree always holds weights (the async path re-pulls them
                 # right after every step), so the live state is consistent
+                comm_state, comm_meta = _comm_ckpt()
                 ckpt_mod.save_sharded(
                     sharded_checkpoint_dir, epoch, params, aux=aux,
                     symbol=self.symbol, opt_state=opt_state,
+                    comm_state=comm_state,
                     extra_meta={"epoch": epoch, "num_update": num_update,
-                                "preempted": True, **_guard_meta()})
+                                "preempted": True, **_guard_meta(),
+                                **comm_meta})
                 logger.info("preemption: flushed checkpoint step %d "
                             "(epoch %d, %d updates)", epoch, epoch,
                             num_update)
@@ -1282,7 +1406,8 @@ class FeedForward(BASE_ESTIMATOR):
                             metric=eval_metric if use_device_metric else None,
                             apply_update=not async_kv,
                             guard_cfg=guard_cfg, pad_policy=pad_policy,
-                            compression=comm_spec)
+                            compression=comm_spec,
+                            overlap_plan=overlap_plan)
                     train_step = train_steps[bkey]
                     pad_tail = ()
                     if pad_policy is not None:
@@ -1349,7 +1474,12 @@ class FeedForward(BASE_ESTIMATOR):
                             # exact device phase: wait for the step's
                             # output buffers (see TelemetryConfig.sync)
                             jax.block_until_ready(res)
-                        span.mark("kvstore" if async_kv else "host")
+                        # stale-sync: the kvstore slot becomes "wire" — it
+                        # times only the un-hidden tail of the PREVIOUS
+                        # round's push (the hidden part lands as an
+                        # "overlap" sub-span from push_pull_stale)
+                        span.mark("wire" if stale_sync
+                                  else ("kvstore" if async_kv else "host"))
                     params, opt_state, aux, outs, maccum.state = res[:5]
                     idx = 5
                     if guard_cfg is not None:
@@ -1365,7 +1495,15 @@ class FeedForward(BASE_ESTIMATOR):
                         step_finite = bool(
                             np.asarray(_host_local(gstate["last_finite"])))
                     if async_kv:
-                        if step_finite:
+                        if step_finite and stale_sync:
+                            # pipelined push: THIS step's grads go to the
+                            # parameter host on a background thread while
+                            # the next step computes; the weights returned
+                            # are one round stale (overlap= on dist_async)
+                            pulled = kv.push_pull_stale(
+                                {name: _host_local(params[name])
+                                 for name in param_names})
+                        elif step_finite:
                             # params slot carries grads (apply_update=False):
                             # ONE round trip applies them on the host
                             # (updated on arrival) and returns the fresh
@@ -1374,6 +1512,10 @@ class FeedForward(BASE_ESTIMATOR):
                             pulled = kv.push_pull(
                                 {name: _host_local(params[name])
                                  for name in param_names})
+                        elif stale_sync:
+                            # guard tripped: drain the in-flight round, drop
+                            # the bad grads, re-pull current weights
+                            pulled = kv.flush_stale(param_names)
                         else:
                             # guard tripped: the grads are non-finite — do
                             # NOT poison the parameter host; re-pull the
@@ -1421,6 +1563,11 @@ class FeedForward(BASE_ESTIMATOR):
             finally:
                 if feed_depth > 0:
                     feed.close()
+            if stale_sync:
+                # drain the pipeline at the epoch boundary: the last step's
+                # push must land before callbacks/checkpoints read weights
+                pulled = kv.flush_stale(param_names)
+                params = {k: jnp.asarray(pulled[k]) for k in param_names}
             if use_device_metric:
                 maccum.finish()
             # stop the epoch clock only once the last step's buffers are
@@ -1469,6 +1616,27 @@ class FeedForward(BASE_ESTIMATOR):
                         "host (%s; fp32 would be %.2f MB, %.1fx)", epoch,
                         sent_d / 1e6, async_comm_spec.mode, raw_d / 1e6,
                         raw_d / sent_d)
+            if stale_sync and tl is not None:
+                # overlap accounting (needs the sync timeline): wire phase
+                # = the blocked tail, overlap subs = what the pipeline hid
+                spans_e = tl.spans[epoch_span_base:]
+                compute_s = sum(d for s in spans_e
+                                for n, _, d in s.phases() if n == "device")
+                tail_s = sum(d for s in spans_e
+                             for n, _, d in s.phases() if n == "wire")
+                hidden_s = sum(d for s in spans_e
+                               for n, _, d in s.subs if n == "overlap")
+                # step = the schedule-controlled time (device compute +
+                # blocking wire tail) — NOT the whole span: data_wait/
+                # dispatch/host stalls are not the pipeline's doing and
+                # would read as negative efficiency on a slow dataloader
+                eff = comm_mod.overlap_efficiency(
+                    compute_s + tail_s, compute_s, tail_s + hidden_s)
+                telemetry_mod.gauge("comm_overlap_efficiency", eff)
+                logger.info(
+                    "Epoch[%d] Overlap: %.2fs on the wire (%.2fs hidden "
+                    "under compute, %.2fs blocking tail), efficiency=%.2f",
+                    epoch, tail_s + hidden_s, hidden_s, tail_s, eff)
             if guard_cfg is not None:
                 self.guard_stats["skipped_steps"] = int(np.asarray(
                     _host_local(gstate["skipped"])))
@@ -1493,11 +1661,14 @@ class FeedForward(BASE_ESTIMATOR):
             if sharded_checkpoint_dir is not None:
                 from .utils import checkpoint as ckpt_mod
 
+                comm_state, comm_meta = _comm_ckpt()
                 ckpt_mod.save_sharded(
                     sharded_checkpoint_dir, epoch + 1, params, aux=aux,
                     symbol=self.symbol, opt_state=opt_state,
+                    comm_state=comm_state,
                     extra_meta={"epoch": epoch + 1,
-                                "num_update": num_update, **_guard_meta()})
+                                "num_update": num_update, **_guard_meta(),
+                                **comm_meta})
 
             if mfu_acct is not None and nbatch:
                 spans_e = tl.spans[epoch_span_base:] if tl is not None else []
@@ -1552,8 +1723,8 @@ class FeedForward(BASE_ESTIMATOR):
     # -- AOT warmup -----------------------------------------------------------
     def precompile(self, data_shapes=None, label_shapes=None, *, data=None,
                    eval_metric="accuracy", kvstore="local", guards=None,
-                   pad_policy=None, compression=None, batch_end_callback=None,
-                   parallel=True):
+                   pad_policy=None, compression=None, overlap=None,
+                   batch_end_callback=None, parallel=True):
         """AOT warmup: compile every fused train program ``fit`` would need
         BEFORE training, via ``.lower().compile()`` — so step 1 of each
         shape dispatches a ready executable instead of stalling on XLA
@@ -1607,6 +1778,7 @@ class FeedForward(BASE_ESTIMATOR):
         from . import comm as comm_mod
 
         comm_spec = comm_mod.CompressionSpec.resolve(compression)
+        overlap_cfg = comm_mod.OverlapConfig.resolve(overlap)
         metric = metric_mod.create(eval_metric)
         # same fusion decision as fit(): a batch callback needs per-batch
         # host metric values, so the metric stays out of the step program
@@ -1628,6 +1800,14 @@ class FeedForward(BASE_ESTIMATOR):
         mesh = self._make_mesh(dist=False)
         if mesh is None:
             comm_spec = None  # matches fit(): no mesh, no wire, no comm
+        overlap_plan = None
+        if comm_spec is not None and overlap_cfg is not None:
+            # the EXACT plan fit() will build — same symbol order, shapes,
+            # cap — so the warmed program is the one fit dispatches
+            overlap_plan = comm_mod.plan_overlap(
+                {k: tuple(self.arg_params[k].shape) for k in param_names},
+                comm_spec, int(mesh.shape["dp"]),
+                max_bytes=overlap_cfg.bucket_bytes, symbol=self.symbol)
         optimizer = self._resolve_optimizer(param_names, batch_size)
 
         def _sds(shape, dtype, sharded=False):
@@ -1658,7 +1838,8 @@ class FeedForward(BASE_ESTIMATOR):
                 bkey, data_names_p, label_names_p, optimizer, mesh,
                 metric=metric if use_device_metric else None,
                 apply_update=True, guard_cfg=guard_cfg,
-                pad_policy=pad_policy, compression=comm_spec)
+                pad_policy=pad_policy, compression=comm_spec,
+                overlap_plan=overlap_plan)
             batch_s = {}
             for name, spec in {**d, **l}.items():
                 shape, dtype = _split(spec)
@@ -1669,11 +1850,19 @@ class FeedForward(BASE_ESTIMATOR):
                 args += (guards_mod.init_guard_state(guard_cfg),)
             if comm_spec is not None and comm_spec.error_feedback:
                 ndev = int(mesh.shape["dp"])
-                Lp = comm_mod.padded_flat_size(
-                    sum(int(np.prod(self.arg_params[k].shape))
-                        for k in param_names), comm_spec, ndev)
-                args += ({"resid": _sds((ndev, Lp), np.dtype(np.float32),
-                                        sharded=True)},)
+                if overlap_plan is not None:
+                    resid_s = {name: _sds((ndev, lp), np.dtype(np.float32),
+                                          sharded=True)
+                               for name, lp
+                               in overlap_plan.padded_sizes().items()}
+                    args += ({"resid": resid_s},)
+                else:
+                    Lp = comm_mod.padded_flat_size(
+                        sum(int(np.prod(self.arg_params[k].shape))
+                            for k in param_names), comm_spec, ndev)
+                    args += ({"resid": _sds((ndev, Lp),
+                                            np.dtype(np.float32),
+                                            sharded=True)},)
             if pad_policy is not None:
                 args += (_sds((), np.dtype(np.int32)),)
             jobs.append((step._tracked, args))

@@ -75,7 +75,8 @@ def grad_accum(loss_fn, params, batch, n_micro):
 
 
 def make_data_parallel_step(loss_fn, update_fn, mesh, axis="dp",
-                            donate=True, n_micro=1, compression=None):
+                            donate=True, n_micro=1, compression=None,
+                            overlap=None):
     """Build a jitted data-parallel train step over ``mesh``.
 
     ``loss_fn(params, batch) -> scalar mean loss``;
@@ -97,12 +98,24 @@ def make_data_parallel_step(loss_fn, update_fn, mesh, axis="dp",
     loss, comm_state)`` — seed it with
     ``comm.init_error_feedback(params, spec, mesh.shape[axis])`` placed
     ``P(axis)`` on the mesh.
+
+    ``overlap`` (True / bucket byte cap / comm.OverlapConfig; needs
+    ``compression``) splits the sync into independent per-bucket
+    collective pairs XLA can hide under backward (comm/overlap.py). The
+    comm state becomes per-bucket residual ledgers: seed with
+    ``comm.init_overlap_residuals(comm.plan_overlap({k: v.shape ...},
+    spec, ndev, max_bytes=...))`` placed ``P(axis)`` — without a Symbol
+    graph the plan orders parameters by sorted name, reversed, which both
+    this helper (from the gradient tree, traced) and your seeding call
+    rebuild identically.
     """
-    from ..comm import (CompressionSpec, compressed_allreduce,
-                        error_feedback_allreduce)
+    from ..comm import (CompressionSpec, OverlapConfig, compressed_allreduce,
+                        error_feedback_allreduce, overlap_allreduce,
+                        plan_overlap)
 
     rep = NamedSharding(mesh, P())
     spec = CompressionSpec.resolve(compression)
+    overlap_cfg = OverlapConfig.resolve(overlap) if spec is not None else None
 
     if spec is None:
         def step(params, opt_state, batch):
@@ -132,6 +145,24 @@ def make_data_parallel_step(loss_fn, update_fn, mesh, axis="dp",
         # per-shard loss_fn means over local rows: the global mean gradient
         # is the average of shard gradients
         loss = jax.lax.pmean(loss, axis)
+        if overlap_cfg is not None:
+            if not isinstance(grads, dict):
+                from ..base import MXNetError
+
+                raise MXNetError(
+                    "overlap= needs a flat {name: array} params dict (the "
+                    "bucket schedule is keyed by parameter name)")
+            # shapes are trace-time constants, so the plan rebuilt here is
+            # byte-identical to the one the caller seeded residuals from
+            plan = plan_overlap({k: tuple(g.shape)
+                                 for k, g in grads.items()}, spec, ndev,
+                                max_bytes=overlap_cfg.bucket_bytes)
+            grads, resid = overlap_allreduce(
+                grads, comm_state[0] if has_ef else None, plan,
+                axis_name=axis, average=True)
+            if has_ef:
+                return loss, grads, resid
+            return loss, grads
         if has_ef:
             grads, resid = error_feedback_allreduce(
                 grads, comm_state[0], spec, axis_name=axis, axis_size=ndev,

@@ -13,6 +13,11 @@ split into ordered, non-overlapping **phases**:
               output buffers (``jax.block_until_ready`` on the result
               pytree; the optimizer update is fused into this program)
   kvstore     parameter-host round trip (dist_async push_pull), when any
+  wire        stale-sync mode (``fit(overlap=...)`` on dist_async): only
+              the UN-hidden tail of the previous round's pipelined push —
+              the hidden portion lands as an ``overlap`` sub-span from
+              ``AsyncKVStore.push_pull_stale``, and the
+              ``comm_overlap_efficiency`` gauge summarizes the split
   host        metric update + callbacks until the next batch is requested
 
 plus **instant events** (guard retries, skipped steps, checkpoint flushes)

@@ -107,13 +107,19 @@ def _chaos_corrupt(step_dir):
 
 
 def save_sharded(directory, step, params, aux=None, symbol=None,
-                 extra_meta=None, opt_state=None):
+                 extra_meta=None, opt_state=None, comm_state=None):
     """Atomically write a sharded checkpoint for ``step`` under ``directory``.
 
     params/aux may hold jax.Arrays sharded over a live mesh — each process
     persists its addressable shards (orbax/tensorstore OCDBT layout), so no
     host ever materializes the full state (the reference's rank-0
     whole-array write cannot scale past host memory).
+
+    ``comm_state``: optional ``{name: array}`` gradient-sync training state
+    (the comm subsystem's error-feedback residuals — per-bucket ledgers
+    under the overlap scheduler). Callers should also record the layout
+    identity (``OverlapPlan.layout_key()``) in ``extra_meta`` so a resumed
+    run can tell whether the saved residuals still describe its buckets.
 
     Write order: state + symbol + manifest + metadata all land in a hidden
     ``.tmp.<step>`` dir; the final ``os.rename`` is the commit point. A
@@ -124,7 +130,8 @@ def save_sharded(directory, step, params, aux=None, symbol=None,
     t0 = telemetry.hub().now()
     with telemetry.phase("checkpoint_save"):
         out = _save_sharded(directory, step, params, aux=aux, symbol=symbol,
-                            extra_meta=extra_meta, opt_state=opt_state)
+                            extra_meta=extra_meta, opt_state=opt_state,
+                            comm_state=comm_state)
     telemetry.counter("checkpoint_saves_total")
     telemetry.emit("checkpoint", step=int(step),
                    seconds=telemetry.hub().now() - t0)
@@ -132,7 +139,7 @@ def save_sharded(directory, step, params, aux=None, symbol=None,
 
 
 def _save_sharded(directory, step, params, aux=None, symbol=None,
-                  extra_meta=None, opt_state=None):
+                  extra_meta=None, opt_state=None, comm_state=None):
     directory = os.path.abspath(os.fspath(directory))
     os.makedirs(directory, exist_ok=True)
     step = int(step)
@@ -154,6 +161,8 @@ def _save_sharded(directory, step, params, aux=None, symbol=None,
         # stored as flat leaves: orbax turns tuples into lists on restore,
         # so the caller re-threads them through its own treedef
         state["opt"] = list(jax.tree_util.tree_leaves(opt_state))
+    if comm_state is not None:
+        state["comm"] = dict(comm_state)
     _checkpointer().save(os.path.join(tmp_dir, _STATE_DIR), state)
     if multi:
         from jax.experimental import multihost_utils
@@ -242,10 +251,15 @@ def latest_step(directory, verify=None):
     return None
 
 
-def load_sharded(directory, step=None, shardings=None):
+def load_sharded(directory, step=None, shardings=None, with_comm=False):
     """Restore ``(params, aux, symbol, meta, opt_leaves)`` from a sharded
     checkpoint. ``opt_leaves`` is the flat optimizer-state leaf list (or
     None) — re-thread it through your optimizer's treedef.
+
+    ``with_comm=True`` appends a sixth element: the saved gradient-sync
+    state (``{name: array}`` error-feedback residuals, or None) — validate
+    it against the current bucket plan (``comm.residuals_match_plan`` +
+    the ``comm_layout`` metadata key) before reuse.
 
     ``shardings``: optional pytree (matching {"params": ..., "aux": ...})
     of `jax.sharding.Sharding` — arrays are restored directly into that
@@ -281,9 +295,12 @@ def load_sharded(directory, step=None, shardings=None):
     params = state.get("params", {})
     aux = state.get("aux", {})
     opt_leaves = state.get("opt")
+    comm_state = state.get("comm")
     if shardings is None:
         params = {k: np.asarray(v) for k, v in params.items()}
         aux = {k: np.asarray(v) for k, v in aux.items()}
+        if comm_state is not None:
+            comm_state = {k: np.asarray(v) for k, v in comm_state.items()}
 
     symbol = None
     sym_path = os.path.join(step_dir, _SYMBOL_FILE)
@@ -296,4 +313,6 @@ def load_sharded(directory, step=None, shardings=None):
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
+    if with_comm:
+        return params, aux, symbol, meta, opt_leaves, comm_state
     return params, aux, symbol, meta, opt_leaves

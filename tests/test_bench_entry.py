@@ -92,6 +92,45 @@ def test_bench_trace_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_TRACE_*
 
 
+def test_bench_overlap_smoke_json_contract():
+    """--overlap-bench --smoke is the CI guard on the comm/compute
+    overlap bench entry: one JSON line with the contract keys, the
+    per-bucket schedule proven structurally (>= 2 independent HLO
+    collective pairs, per-bucket plans summing exactly to the fused
+    plan), the stale-sync pipeline strictly beating the serial
+    schedule, a positive overlap-efficiency gauge, and the telemetry
+    tax under the 2% invariant."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--overlap-bench", "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "mesh",
+                "stale_sync", "overlap_efficiency",
+                "telemetry_overhead_pct"):
+        assert key in blob, blob
+    assert blob["metric"] == "overlap_bench_stale_sync_speedup"
+    # ACCEPTANCE: the overlapped schedule strictly beats the serial one
+    assert blob["value"] > 1.0, blob
+    assert blob["stale_sync"]["step_ms_pipelined"] < \
+        blob["stale_sync"]["step_ms_serial"]
+    # ACCEPTANCE: >= 2 independent per-bucket collective pair groups in
+    # the compiled HLO, and the plan arithmetic is exact vs fused
+    assert blob["mesh"]["hlo_independent_pairs"] >= 2, blob["mesh"]
+    assert blob["mesh"]["num_buckets"] >= 2
+    assert blob["mesh"]["plan_matches_fused"] is True
+    assert blob["mesh"]["loss_parity"] is True
+    # ACCEPTANCE: efficiency gauge exported and positive, telemetry tax
+    # within the <2% invariant
+    assert blob["overlap_efficiency"] > 0, blob
+    assert 0 <= blob["telemetry_overhead_pct"] < 2.0, blob
+    assert blob["smoke"] is True  # smoke runs never write BENCH_OVERLAP_*
+
+
 @pytest.mark.slow
 def test_bench_pipeline_mode_json_contract(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -492,6 +492,113 @@ def test_tree_has_no_mx307_findings():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+# -- MX308 unpinned-wire-collective fixtures (ISSUE 7 satellite) ---------------
+
+def test_fixture_mx308_unpinned_collective():
+    # a wire collective in comm/ with no optimization_barrier anywhere:
+    # XLA can commute the encode/decode casts across it (fp32 on the
+    # wire, compression silently lost — allreduce.py's documented bug
+    # class)
+    src = (
+        "import jax.lax as lax\n"
+        "def exchange(q, axis):\n"
+        "    s = lax.all_to_all(q, axis, 0, 0)\n"
+        "    return lax.all_gather(s, axis)\n"
+    )
+    findings = lint_source(src, "mxnet_tpu/comm/fx.py")
+    assert _ids(findings) == ["MX308", "MX308"]
+    assert sorted(f.line for f in findings) == [3, 4]
+    # pinned on one side only is still flagged (the convert commutes
+    # across whichever side is open)
+    src2 = (
+        "import jax.lax as lax\n"
+        "def exchange(q, axis):\n"
+        "    (q,) = lax.optimization_barrier((q,))\n"
+        "    return lax.all_to_all(q, axis, 0, 0)\n"
+    )
+    assert _ids(lint_source(src2, "mxnet_tpu/comm/fx.py")) == ["MX308"]
+
+
+def test_fixture_mx308_pinned_and_out_of_scope():
+    # barriers lexically before AND after the collective: clean
+    src = (
+        "import jax.lax as lax\n"
+        "def exchange(q, axis):\n"
+        "    (q,) = lax.optimization_barrier((q,))\n"
+        "    s = lax.all_to_all(q, axis, 0, 0)\n"
+        "    g = lax.all_gather(s, axis)\n"
+        "    (g,) = lax.optimization_barrier((g,))\n"
+        "    return g\n"
+    )
+    assert _ids(lint_source(src, "mxnet_tpu/comm/fx.py")) == []
+    # the rule is scoped to comm/: collectives elsewhere are not its
+    # business (MX304 polices raw grad psums outside comm/)
+    src2 = (
+        "import jax.lax as lax\n"
+        "def gather(q, axis):\n"
+        "    return lax.all_gather(q, axis)\n"
+    )
+    assert _ids(lint_source(src2, "mxnet_tpu/parallel/fx.py")) == []
+    # nested defs are their own scope: an inner pinned exchange does not
+    # excuse an outer bare one
+    src3 = (
+        "import jax.lax as lax\n"
+        "def outer(q, axis):\n"
+        "    def inner(v):\n"
+        "        (v,) = lax.optimization_barrier((v,))\n"
+        "        v = lax.all_to_all(v, axis, 0, 0)\n"
+        "        (v,) = lax.optimization_barrier((v,))\n"
+        "        return v\n"
+        "    return lax.all_gather(inner(q), axis)\n"
+    )
+    assert _ids(lint_source(src3, "mxnet_tpu/comm/fx.py")) == ["MX308"]
+
+
+def test_fixture_mx308_lambda_and_module_scopes():
+    # a lambda body is its own scope: an unpinned collective in one
+    # cannot hide behind barriers in the enclosing function
+    src = (
+        "import jax.lax as lax\n"
+        "def exchange(q, axis):\n"
+        "    (q,) = lax.optimization_barrier((q,))\n"
+        "    f = lambda v: lax.all_gather(v, axis)\n"
+        "    (q,) = lax.optimization_barrier((q,))\n"
+        "    return f(q)\n"
+    )
+    findings = lint_source(src, "mxnet_tpu/comm/fx.py")
+    assert _ids(findings) == ["MX308"]
+    assert findings[0].line == 4
+    # module-level collectives are scanned too
+    src2 = (
+        "import jax.lax as lax\n"
+        "OUT = lax.all_to_all(IN, 'dp', 0, 0)\n"
+    )
+    assert _ids(lint_source(src2, "mxnet_tpu/comm/fx.py")) == ["MX308"]
+
+
+def test_fixture_mx308_pragma_suppression():
+    src = (
+        "import jax.lax as lax\n"
+        "def exchange(q, axis):\n"
+        "    return lax.all_to_all(q, axis, 0, 0)"
+        "  # mxlint: disable=MX308\n"
+    )
+    assert _ids(lint_source(src, "mxnet_tpu/comm/fx.py")) == []
+    src2 = src.replace("  # mxlint: disable=MX308", "")
+    assert _ids(lint_source(src2, "mxnet_tpu/comm/fx.py")) == ["MX308"]
+
+
+def test_tree_has_no_mx308_findings():
+    """ISSUE 7 satellite: the tree self-lints clean — every wire
+    collective in comm/ (fused AND per-bucket paths) is barrier-pinned
+    on both sides."""
+    from mxnet_tpu.analysis import lint_paths
+
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX308"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 # -- Pass 2: graph verifier fixtures ------------------------------------------
 
 def test_fixture_duplicate_argument():
