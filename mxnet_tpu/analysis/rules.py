@@ -184,6 +184,20 @@ register_rule(
     "_exchange for the canonical shape)")
 
 register_rule(
+    "MX309", "warning",
+    "implicit host sync inside a step loop: `.asnumpy()`/`.item()`/"
+    "`np.asarray(...)`/`float(x)` on device values in the same loop that "
+    "dispatches "
+    "the train/eval/predict step — each one blocks the host on a "
+    "device-to-host transfer, serializing the async dispatch pipeline "
+    "(and the comm/compute overlap schedule) and skewing live-array "
+    "memory accounting with transient host copies",
+    "hoist the read out of the loop (pull once per epoch, like the device "
+    "metric path), keep values on device, or — when the sync is the "
+    "point (guard verdicts, host metrics) — annotate the line with "
+    "`# mxlint: disable=MX309` and a justification")
+
+register_rule(
     "MX306", "warning",
     "un-barriered wall-clock delta around device dispatch: a "
     "time.time()/perf_counter() start/stop pair with work between and no "

@@ -15,6 +15,10 @@ Catches, before anything imports or traces:
                pinning on both sides (XLA commutes the encode/decode
                converts across the collective: fp32 on the wire,
                compression silently lost),
+  MX309        implicit host syncs (.asnumpy()/.item()/np.asarray) inside
+               a loop that dispatches the train/eval/predict step — each
+               pull serializes async dispatch and skews memory accounting
+               (intentional per-step syncs carry a disable pragma),
   MX601-602    robustness hazards (bare ``except:``; ``while True`` retry
                loops that swallow exceptions with no backoff/deadline —
                the loop shape that melts a parameter server under a
@@ -663,6 +667,98 @@ def _scan_leaked_spans(tree, path, findings):
                 path=path, line=lineno, col=col))
 
 
+# -- MX309: implicit host syncs inside step loops -----------------------------
+# The silent killer of both async dispatch and memory accounting: a loop
+# that dispatches the fused step AND pulls values to host every iteration
+# (`.asnumpy()`, `.item()`, `np.asarray(...)`) serializes the pipeline —
+# each pull blocks on the in-flight program, so the comm/compute overlap
+# schedule (PR 7) degenerates to lockstep and the live-array ledger sees
+# phantom transient host copies. The scan is loop-local and zero-FP-biased:
+# it only fires inside a for/while loop that visibly dispatches a step (a
+# call whose name contains "step", or forward()/backward()), and only on
+# the unambiguous sync shapes. Intentional per-step syncs (guard verdicts,
+# host-metric paths) carry `# mxlint: disable=MX309` with a justification.
+# telemetry/ and utils/profiler are exempt, as for MX306/307.
+
+_STEP_DISPATCH_PARTS = ("step",)
+_STEP_DISPATCH_EXACT = ("forward", "backward")
+_HOST_PULL_ATTRS = ("asnumpy", "item")
+_HOST_PULL_NUMPY = ("numpy.asarray", "numpy.array", "numpy.ascontiguousarray")
+
+
+def _is_step_dispatch(node):
+    name = _call_attr_name(node)
+    if not name:
+        return False
+    lname = name.lower()
+    return lname in _STEP_DISPATCH_EXACT or \
+        any(part in lname for part in _STEP_DISPATCH_PARTS)
+
+
+def _iter_loop_body_nodes(loop):
+    """Walk a loop's immediate body: nested defs/lambdas are their own
+    scope and nested loops are their own *step loop* (each is judged on
+    its own dispatch) — so a once-per-epoch pull after an inner batch
+    loop is not blamed on the steps inside it."""
+    stack = list(loop.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.For, ast.AsyncFor, ast.While)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scan_step_loop_syncs(tree, path, imports, findings):
+    if _exempt_timing_path(path):
+        return
+    seen = set()  # (line, col): overlapping scopes must not double-report
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        calls = [n for n in _iter_loop_body_nodes(loop)
+                 if isinstance(n, ast.Call)]
+        if not any(_is_step_dispatch(c) for c in calls):
+            continue
+        for call in calls:
+            loc = (call.lineno, call.col_offset)
+            if loc in seen:
+                continue
+            f = call.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in _HOST_PULL_ATTRS and not call.args:
+                seen.add(loc)
+                findings.append(Finding(
+                    get_rule("MX309"),
+                    f"`.{f.attr}()` inside a step-dispatching loop blocks "
+                    "the host on a device transfer every iteration",
+                    path=path, line=call.lineno, col=call.col_offset))
+                continue
+            dotted = _dotted(f, imports)
+            if dotted in _HOST_PULL_NUMPY:
+                seen.add(loc)
+                findings.append(Finding(
+                    get_rule("MX309"),
+                    f"`{dotted}(...)` inside a step-dispatching loop "
+                    "forces a device-to-host copy every iteration",
+                    path=path, line=call.lineno, col=call.col_offset))
+                continue
+            # float(x)/int(x) on a bare name: the classic scalar pull
+            # (loss = float(out)); attribute/subscript args stay exempt —
+            # shapes/pads etc. are host metadata, not device values
+            if isinstance(f, ast.Name) and f.id in ("float", "int") and \
+                    len(call.args) == 1 and \
+                    isinstance(call.args[0], ast.Name):
+                seen.add(loc)
+                findings.append(Finding(
+                    get_rule("MX309"),
+                    f"`{f.id}({call.args[0].id})` inside a "
+                    "step-dispatching loop forces a scalar device-to-host "
+                    "sync every iteration",
+                    path=path, line=call.lineno, col=call.col_offset))
+
+
 # -- MX308: unpinned wire collectives in comm/ --------------------------------
 # The convert-commuting bug class documented at comm/allreduce.py
 # (_exchange): converting before/after pure data movement is elementwise-
@@ -831,6 +927,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     _scan_unbarriered_timing(tree, path, scan.imports, scan.findings)
     _scan_leaked_spans(tree, path, scan.findings)
     _scan_unpinned_collectives(tree, path, scan.findings)
+    _scan_step_loop_syncs(tree, path, scan.imports, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)
     roots += [d for d in scan.defs if d.name in scan.traced_names]

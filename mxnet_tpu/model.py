@@ -1254,9 +1254,27 @@ class FeedForward(BASE_ESTIMATOR):
 
         # -- telemetry wiring (tl None = the loop takes the exact
         # pre-instrumentation path; doc/developer-guide/telemetry.md) ------
+        # OOM preflight (ISSUE 9): with a budget configured
+        # (MXNET_TPU_HBM_BYTES or the backend's bytes_limit), reject an
+        # over-budget configuration NOW — ranked byte report naming the
+        # offending arrays/programs — instead of OOMing mid-epoch. Runs
+        # before any telemetry state is attached so a raise leaks nothing.
+        hbm_budget = telemetry_mod.memory.hbm_budget()
+        if hbm_budget:
+            plan_label, plan = telemetry_mod.memory.largest_plan(
+                (f"train_step:{self._fingerprint_for_bucket(None)}",))
+            entries = telemetry_mod.memory.preflight_entries(
+                params, opt_state, aux,
+                resid=None if cstate is None else cstate["resid"],
+                ndev=int(mesh.shape["dp"]) if mesh is not None else 1,
+                plan_label=plan_label, plan=plan)
+            telemetry_mod.memory.preflight(entries, hbm_budget,
+                                           what="fit", logger=logger)
+
         tl = None
         mfu_acct = None
         tel_sink = None
+        mem_prev = None
         if tcfg is not None:
             if tcfg.timeline:
                 tl = telemetry_mod.StepTimeline()
@@ -1268,6 +1286,14 @@ class FeedForward(BASE_ESTIMATOR):
             if tcfg.jsonl:
                 tel_sink = telemetry_mod.hub().add_sink(
                     telemetry_mod.JsonlWriter(tcfg.jsonl))
+            if tcfg.memory:
+                # live-array ledger + phase-boundary watermark sampler +
+                # epoch leak detector (telemetry/memory.py) — host-side
+                # bookkeeping only, so jit cache keys are untouched and
+                # the armed zero-recompile epoch stays green
+                mem_prev = telemetry_mod.track_arrays(True)
+                telemetry_mod.memory.reset_leak_tracker()
+                telemetry_mod.memory.attach_sampler()
         self._active_timeline = tl
 
         def _ckpt_seconds():
@@ -1492,8 +1518,8 @@ class FeedForward(BASE_ESTIMATOR):
                                                   or not use_device_metric):
                         # these paths sync to host right below anyway; the
                         # in-jit fast path never reads this flag
-                        step_finite = bool(
-                            np.asarray(_host_local(gstate["last_finite"])))
+                        step_finite = bool(np.asarray(  # mxlint: disable=MX309
+                            _host_local(gstate["last_finite"])))
                     if async_kv:
                         if step_finite and stale_sync:
                             # pipelined push: THIS step's grads go to the
@@ -1541,8 +1567,11 @@ class FeedForward(BASE_ESTIMATOR):
                             nv = int(labels_h[0].shape[0]) - int(
                                 getattr(batch, "pad", 0) or 0)
                             outs_h = [o[:nv] for o in outs_h]
+                            # host-metric path: the per-batch pull IS the
+                            # metric contract here (device metrics are the
+                            # sanctioned fast path)
                             labels_h = [
-                                np.asarray(l.asnumpy()
+                                np.asarray(l.asnumpy()  # mxlint: disable=MX309
                                            if hasattr(l, "asnumpy") else l)[:nv]
                                 for l in labels_h]
                         eval_metric.update(labels_h,
@@ -1690,6 +1719,12 @@ class FeedForward(BASE_ESTIMATOR):
 
             _write_back()
 
+            if mem_prev is not None:
+                # close the epoch's watermark window: emits the
+                # memory_watermark event and runs the epoch-over-epoch
+                # leak detector (telemetry/memory.py)
+                telemetry_mod.memory.epoch_mark(epoch, logger=logger)
+
             if eval_data is not None:
                 eval_metric.reset()
                 eval_iter = _init_iter(eval_data[0], eval_data[1], batch_size, is_train=False) \
@@ -1718,6 +1753,9 @@ class FeedForward(BASE_ESTIMATOR):
             if tel_sink is not None:
                 telemetry_mod.hub().remove_sink(tel_sink)
                 tel_sink.close()
+            if mem_prev is not None:
+                telemetry_mod.memory.detach_sampler()
+                telemetry_mod.track_arrays(mem_prev)
         return self
 
     # -- AOT warmup -----------------------------------------------------------
@@ -1831,6 +1869,7 @@ class FeedForward(BASE_ESTIMATOR):
             lambda x: _sds(tuple(x.shape), np.dtype(x.dtype)), mstate)
 
         jobs = []
+        ef_resid_struct = None  # the EF residual shape the warmup lowers for
         for bkey, d, l in programs:
             data_names_p = list(d)
             label_names_p = list(l)
@@ -1863,6 +1902,7 @@ class FeedForward(BASE_ESTIMATOR):
                     args += ({"resid": _sds((ndev, Lp),
                                             np.dtype(np.float32),
                                             sharded=True)},)
+                ef_resid_struct = args[-1]["resid"]
             if pad_policy is not None:
                 args += (_sds((), np.dtype(np.int32)),)
             jobs.append((step._tracked, args))
@@ -1884,6 +1924,21 @@ class FeedForward(BASE_ESTIMATOR):
         wall = time.time() - t0
         logging.info("precompile: %d program(s) ready in %.2fs", len(jobs),
                      wall)
+        # OOM preflight over the EXACT warmed programs: every job just
+        # registered its memory plan, so the check uses real temp/output
+        # bytes — reject an over-budget configuration here, before fit
+        # dispatches a single step (ISSUE 9)
+        hbm_budget = telemetry_mod.memory.hbm_budget()
+        if hbm_budget:
+            plan_label, plan = telemetry_mod.memory.largest_plan(
+                labels=[tj.label for tj, _ in jobs])
+            entries = telemetry_mod.memory.preflight_entries(
+                params_s, opt_state_s, aux_s,
+                resid=ef_resid_struct,
+                ndev=int(mesh.shape["dp"]) if mesh is not None else 1,
+                plan_label=plan_label, plan=plan)
+            telemetry_mod.memory.preflight(entries, hbm_budget,
+                                           what="precompile")
         return {"programs": len(jobs), "wall_seconds": wall,
                 "labels": [tj.label for tj, _ in jobs]}
 
@@ -2098,7 +2153,10 @@ class FeedForward(BASE_ESTIMATOR):
                 jax.block_until_ready(outs)
                 span.mark("host")
             nv = rows - batch.pad
-            outs = [np.asarray(o[:nv] if nv != o.shape[0] else o) for o in outs]
+            # predict materializes host outputs by contract; the pull is
+            # the product, not an accident
+            outs = [np.asarray(o[:nv] if nv != o.shape[0] else o)  # mxlint: disable=MX309
+                    for o in outs]
             if chunks is None:
                 chunks = [[] for _ in outs]
             for lst, o in zip(chunks, outs):

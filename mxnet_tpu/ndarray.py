@@ -68,6 +68,11 @@ __all__ = [
 
 real_t = np.float32
 
+# Live-array ledger hook (telemetry.memory.track_arrays installs/removes
+# it): None keeps the NDArray hot path at one global load + None check;
+# when set, every construction registers a weakref-tracked byte entry.
+_LEDGER = None
+
 
 def _ctx_of(device: jax.Device) -> Context:
     if device.platform == "cpu":
@@ -78,7 +83,9 @@ def _ctx_of(device: jax.Device) -> Context:
 class NDArray:
     """Multi-dimensional array on a device, with async execution semantics."""
 
-    __slots__ = ("_data", "writable")
+    # __weakref__ lets the telemetry memory ledger track live arrays
+    # without keeping them alive (weakref callbacks decrement on GC)
+    __slots__ = ("_data", "writable", "__weakref__")
 
     def __init__(self, data, ctx: Context | None = None, writable: bool = True):
         if isinstance(data, NDArray):
@@ -90,6 +97,8 @@ class NDArray:
             data = jax.device_put(data, ctx.jax_device)
         self._data = data
         self.writable = writable
+        if _LEDGER is not None:
+            _LEDGER.add(self)
 
     # -- core properties ------------------------------------------------------
     @property

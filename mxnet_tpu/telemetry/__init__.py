@@ -47,9 +47,14 @@ from .exporters import (SCHEMA_VERSION, EVENT_GOLDEN_KEYS, JsonlWriter,
                         serve_http, stop_http, summary)
 from . import flight
 from .flight import FlightRecorder, validate_flight
+from . import memory
+from .memory import (ArrayLedger, MemoryPreflightError, track_arrays,
+                     plan_table, forensics_snapshot)
 
 # the black box records from import on (and survives hub resets)
 flight.install()
+# memory plans publish as hub gauges/events from the first AOT compile on
+memory.install()
 
 __all__ = [
     "MetricsHub", "Histogram", "hub", "reset", "DEFAULT_COUNTERS",
@@ -66,6 +71,8 @@ __all__ = [
     "read_jsonl", "read_events", "prom_dump", "serve_http", "stop_http",
     "summary",
     "flight", "FlightRecorder", "validate_flight",
+    "memory", "ArrayLedger", "MemoryPreflightError", "track_arrays",
+    "plan_table", "forensics_snapshot",
     "counter", "gauge", "observe", "emit", "TelemetryConfig",
     "maybe_serve_http_from_env",
 ]
@@ -97,17 +104,22 @@ class TelemetryConfig:
     ``timeline``: per-step span tracing; ``mfu``: FLOP/goodput accounting;
     ``sync``: block on each step's outputs for exact device-phase timing
     (the attribution/pipelining trade — see timeline.py); ``jsonl``: a
-    path to stream every hub event to as it happens."""
+    path to stream every hub event to as it happens; ``memory``: the
+    live-array ledger + phase-boundary watermark sampler + epoch leak
+    detector (memory.py — host-side bookkeeping, <2% of a step)."""
 
-    def __init__(self, timeline=True, mfu=True, sync=True, jsonl=None):
+    def __init__(self, timeline=True, mfu=True, sync=True, jsonl=None,
+                 memory=True):
         self.timeline = bool(timeline)
         self.mfu = bool(mfu)
         self.sync = bool(sync)
         self.jsonl = jsonl
+        self.memory = bool(memory)
 
     def __repr__(self):
         return (f"TelemetryConfig(timeline={self.timeline}, mfu={self.mfu}, "
-                f"sync={self.sync}, jsonl={self.jsonl!r})")
+                f"sync={self.sync}, jsonl={self.jsonl!r}, "
+                f"memory={self.memory})")
 
     @classmethod
     def resolve(cls, value):

@@ -4,6 +4,7 @@
     python -m mxnet_tpu.telemetry summarize run.jsonl
     python -m mxnet_tpu.telemetry merge r0.jsonl r1.jsonl ... -o fleet.json
     python -m mxnet_tpu.telemetry diff A.jsonl B.jsonl [--threshold 10]
+    python -m mxnet_tpu.telemetry mem run.jsonl
     python -m mxnet_tpu.telemetry flight show dump.json [-n 10]
     python -m mxnet_tpu.telemetry flight validate dump.json
 
@@ -12,10 +13,14 @@ per kind, span/phase time totals, badput buckets, MFU/goodput lines).
 ``merge`` joins N per-rank streams on (trace_id, rank, step) into one
 clock-aligned fleet Chrome trace, prints the join report, and runs the
 straggler detector (``--no-stragglers`` to skip). ``diff`` compares
-step-time/MFU/goodput percentiles between two runs and exits nonzero on a
-regression beyond the threshold — a CI perf gate. ``flight`` renders and
-CRC-validates flight-recorder dumps. All readers take schema v1 (PR 5)
-and v2 (distributed tracing) files; v1 rows read as rank 0 of world 1.
+step-time/MFU/goodput percentiles AND the peak live-array watermark
+between two runs and exits nonzero on a regression beyond the threshold
+— a CI perf gate. ``mem`` renders the memory-observability view of a run:
+the per-program HBM plan table (``--jaxpr-table`` style), per-epoch
+watermarks, and any leak/preflight incidents. ``flight`` renders and
+CRC-validates flight-recorder dumps (including the memory snapshot
+section). All readers take schema v1 (PR 5) and v2 (distributed tracing)
+files; v1 rows read as rank 0 of world 1.
 """
 
 from __future__ import annotations
@@ -128,6 +133,48 @@ def cmd_merge(args):
     return 0
 
 
+def cmd_mem(args):
+    """The bytes view of one run's JSONL stream: program plans, epoch
+    watermarks, leak + preflight incidents."""
+    from .memory import plan_table
+
+    events = read_events(args.path)
+    plan_rows = {}
+    for e in events:
+        if e.get("kind") == "memory_plan":
+            plan_rows[e.get("program", "?")] = e  # latest plan wins
+    watermarks = [e for e in events if e.get("kind") == "memory_watermark"]
+    leaks = [e for e in events if e.get("kind") == "memory_leak"]
+    preflights = [e for e in events if e.get("kind") == "memory_preflight"]
+    if not (plan_rows or watermarks or leaks or preflights):
+        print(f"{args.path}: no memory events (run fit with telemetry on, "
+              f"or precompile() to register program plans)")
+        return 1
+    if plan_rows:
+        print("per-program memory plans:")
+        print(plan_table(plan_rows))
+    if watermarks:
+        print("live-array watermarks:")
+        for e in watermarks:
+            print(f"  epoch {e.get('epoch')}: watermark "
+                  f"{float(e.get('watermark_bytes', 0)) / (1 << 20):.2f} MB "
+                  f"({e.get('live_count', '?')} live arrays, "
+                  f"{float(e.get('live_bytes', 0)) / (1 << 20):.2f} MB live "
+                  f"at mark)")
+    for e in leaks:
+        print(f"MEMORY LEAK flagged at epoch {e.get('epoch')}: watermark "
+              f"drifted up {e.get('epochs')} consecutive epoch(s) "
+              f"(+{float(e.get('drift_bytes', 0)) / (1 << 20):.2f} MB last)")
+    for e in preflights:
+        verdict = "ok" if e.get("fits") else "OVER BUDGET"
+        budget = e.get("budget_bytes")
+        print(f"preflight ({e.get('what')}): "
+              f"{float(e.get('total_bytes', 0)) / (1 << 20):.2f} MB needed, "
+              + (f"budget {float(budget) / (1 << 20):.2f} MB — {verdict}"
+                 if budget else "no budget configured"))
+    return 0
+
+
 # diff metrics: (label, extractor over events, higher_is_worse)
 def _span_dur_ms(events):
     return sorted(float(e.get("dur_ms", 0.0)) for e in events
@@ -164,6 +211,12 @@ def _run_metrics(events):
         out["mfu_pct"] = (sum(mfu) / len(mfu), False)  # lower = worse
     if goodput:
         out["goodput_pct"] = (sum(goodput) / len(goodput), False)
+    # peak-memory regression gate (ISSUE 9): the run's highest live-array
+    # watermark, comparable whenever both runs tracked memory
+    peaks = [float(e.get("watermark_bytes", 0.0)) for e in events
+             if e.get("kind") == "memory_watermark"]
+    if peaks:
+        out["peak_mem_mb"] = (max(peaks) / (1 << 20), True)  # higher=worse
     return out
 
 
@@ -249,6 +302,37 @@ def cmd_flight(args):
         print("non-zero counters:")
         for k, v in sorted(counters.items()):
             print(f"  {k}: {v:g}")
+    mem = payload.get("memory")
+    if isinstance(mem, dict):  # absent on pre-ISSUE-9 dumps; torn/odd
+        # sections render best-effort (the CRC already proved integrity)
+        led = mem.get("ledger") or {}
+        if led:
+            print(f"memory: {float(led.get('live_bytes', 0)) / (1 << 20):.2f}"
+                  f" MB live in {led.get('live_count', 0)} arrays "
+                  f"(watermark "
+                  f"{float(led.get('watermark_bytes', 0)) / (1 << 20):.2f} "
+                  f"MB, tracking={'on' if mem.get('tracking') else 'off'})")
+        for row in (mem.get("top_arrays") or [])[:args.n]:
+            if isinstance(row, dict):
+                print(f"  {float(row.get('bytes', 0)) / (1 << 20):9.3f} MB  "
+                      f"{row.get('dtype')}{tuple(row.get('shape', ()))} "
+                      f"@{row.get('platform')}")
+        plans_sec = mem.get("plans") or {}
+        if isinstance(plans_sec, dict) and plans_sec:
+            print(f"largest program plans ({len(plans_sec)}):")
+            for label, plan in plans_sec.items():
+                if isinstance(plan, dict):
+                    print(f"  {float(plan.get('total_bytes', 0)) / (1 << 20):9.3f}"
+                          f" MB  {label}")
+        alloc = mem.get("allocator") or {}
+        for dev, row in sorted(alloc.items()) if isinstance(alloc, dict) \
+                else []:
+            if isinstance(row, dict) and row.get("bytes_in_use"):
+                print(f"  allocator {dev}: "
+                      f"{float(row['bytes_in_use']) / (1 << 20):.2f} MB in "
+                      f"use, peak "
+                      f"{float(row.get('peak_bytes_in_use', 0)) / (1 << 20):.2f}"
+                      f" MB")
     return 0
 
 
@@ -283,6 +367,11 @@ def main(argv=None):
     d.add_argument("--threshold", type=float, default=10.0,
                    help="regression threshold in percent (default 10)")
     d.set_defaults(fn=cmd_diff)
+    mm = sub.add_parser("mem", help="memory view: program plan table, "
+                                    "epoch watermarks, leak/preflight "
+                                    "incidents")
+    mm.add_argument("path")
+    mm.set_defaults(fn=cmd_mem)
     f = sub.add_parser("flight", help="render / CRC-validate a flight "
                                       "recorder dump")
     f.add_argument("action", choices=("show", "validate"))

@@ -48,6 +48,12 @@ EVENT_GOLDEN_KEYS = {
     "flight_dump": ("reason", "path"),
     "watchdog": ("deadline",),
     "chaos": ("site",),
+    # memory observability (ISSUE 9)
+    "memory_plan": ("program", "argument_bytes", "output_bytes",
+                    "temp_bytes", "total_bytes"),
+    "memory_watermark": ("epoch", "watermark_bytes", "live_bytes"),
+    "memory_leak": ("epoch", "drift_bytes", "watermark_bytes"),
+    "memory_preflight": ("what", "total_bytes", "fits"),
 }
 
 

@@ -50,7 +50,7 @@ FLIGHT_FORMAT = 1
 # event kinds that are incidents: the "what went wrong" ring
 INCIDENT_KINDS = frozenset({
     "retry", "circuit_open", "step_event", "server_dedup", "watchdog",
-    "chaos", "badput", "guard_trip", "preempt",
+    "chaos", "badput", "guard_trip", "preempt", "memory_leak",
 })
 
 
@@ -135,6 +135,15 @@ class FlightRecorder:
         h = _hub()
         steps, events, incidents = self.snapshot(only_rank=only_rank)
         rank = dist_mod.current_rank() if only_rank is None else int(only_rank)
+        try:
+            # allocator + ledger + top-plans snapshot (ISSUE 9 forensics);
+            # a failing section degrades to absence — the black box must
+            # always land, with or without its memory page
+            from . import memory as memory_mod
+
+            mem_snapshot = memory_mod.forensics_snapshot()
+        except Exception:
+            mem_snapshot = None
         payload = {
             "format": FLIGHT_FORMAT,
             "v": SCHEMA_VERSION,
@@ -150,6 +159,8 @@ class FlightRecorder:
             "counters": {k: v for k, v in
                          h.snapshot()["counters"].items() if v},
         }
+        if mem_snapshot is not None:
+            payload["memory"] = mem_snapshot
         body = json.dumps(payload, sort_keys=True, default=str)
         blob = {"format": FLIGHT_FORMAT,
                 "crc32": zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF,

@@ -50,6 +50,12 @@ __all__ = ["Span", "StepTimeline", "current_span", "clear_current_span",
 
 _TLS = threading.local()
 
+# Phase-boundary memory sampler (telemetry.memory.attach_sampler installs
+# it): called with the span at every phase mark and span finish, so the
+# live-array ledger's gauges/watermark track intra-step boundaries. None
+# (the default) keeps the hot path at one global None check.
+_MEM_SAMPLER = None
+
 
 def current_span():
     """The span currently open on this thread, or None."""
@@ -135,6 +141,8 @@ class Span:
 
     def mark(self, name, ts=None):
         self._marks.append((name, time.perf_counter() if ts is None else ts))
+        if _MEM_SAMPLER is not None:
+            _MEM_SAMPLER(self)
         return self
 
     def add_sub(self, name, start, dur):
@@ -229,6 +237,8 @@ class StepTimeline:
         self._hub.observe("step_seconds", span.duration,
                           kind=span.kind)
         self._hub.emit("span", **span.to_dict())
+        if _MEM_SAMPLER is not None:
+            _MEM_SAMPLER(span)
 
     # -- queries --------------------------------------------------------------
     def steps(self, kind="step"):

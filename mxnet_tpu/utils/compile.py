@@ -65,6 +65,8 @@ __all__ = [
     "tracked_jit", "TrackedJit", "graph_fingerprint",
     "RecompileTracker", "RecompileError",
     "PadPolicy",
+    "MEMORY_PLAN_FIELDS", "memory_plan_from_compiled",
+    "add_memory_plan_listener",
 ]
 
 
@@ -133,6 +135,49 @@ def persistent_cache_dir():
 # -- 2. program registry -------------------------------------------------------
 
 _UNTRACKED = "<untracked>"
+
+# Static memory plans (ISSUE 9): every AOT-compiled program registers its
+# XLA memory_analysis() breakdown here, keyed by the same program label as
+# the compile stats — the framework's answer to the reference's
+# GraphExecutor::Print "Total N MB allocated" line, but queryable without
+# re-lowering anything. The telemetry layer subscribes via
+# add_memory_plan_listener to export plans as hub gauges/events.
+MEMORY_PLAN_FIELDS = ("argument_bytes", "output_bytes", "temp_bytes",
+                      "generated_code_bytes", "alias_bytes", "total_bytes")
+
+_PLAN_ATTRS = (("argument_bytes", "argument_size_in_bytes"),
+               ("output_bytes", "output_size_in_bytes"),
+               ("temp_bytes", "temp_size_in_bytes"),
+               ("generated_code_bytes", "generated_code_size_in_bytes"),
+               ("alias_bytes", "alias_size_in_bytes"))
+
+_MEMORY_PLAN_LISTENERS: list = []
+
+
+def add_memory_plan_listener(fn):
+    """Register ``fn(label, plan_dict)`` to run whenever a program's memory
+    plan is (re)recorded — the telemetry layer's hook; utils/compile itself
+    stays jax+stdlib only."""
+    _MEMORY_PLAN_LISTENERS.append(fn)
+    return fn
+
+
+def memory_plan_from_compiled(compiled):
+    """Extract a memory plan dict from a compiled executable's
+    ``memory_analysis()``. Returns None when the backend doesn't expose it
+    (the caller degrades to "unavailable", never fails). ``total_bytes``
+    matches Executor.debug_str's historical "Total" line: temp + output —
+    what the program itself allocates beyond its arguments."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    plan = {field: int(getattr(mem, attr, 0) or 0)
+            for field, attr in _PLAN_ATTRS}
+    plan["total_bytes"] = plan["temp_bytes"] + plan["output_bytes"]
+    return plan
 
 
 def _label_counters():
@@ -221,10 +266,34 @@ class ProgramRegistry:
         if kind == "miss":
             _notify_trackers(label, signature)
 
+    # -- memory plans (ISSUE 9) -----------------------------------------------
+    def record_memory_plan(self, label, plan):
+        """Store a program's static memory plan under its compile label
+        (idempotent re-record wins) and notify plan listeners."""
+        plan = dict(plan)
+        with self._lock:
+            self._memory_plans[label] = plan
+        for fn in list(_MEMORY_PLAN_LISTENERS):
+            try:
+                fn(label, dict(plan))
+            except Exception:  # a telemetry sink must not fail a compile
+                logging.debug("memory-plan listener failed for %r", label,
+                              exc_info=True)
+
+    def memory_plan_for(self, label):
+        with self._lock:
+            plan = self._memory_plans.get(label)
+            return None if plan is None else dict(plan)
+
+    def memory_plans(self):
+        with self._lock:
+            return {k: dict(v) for k, v in self._memory_plans.items()}
+
     # -- reporting ------------------------------------------------------------
     def reset(self):
         with getattr(self, "_lock", contextlib.nullcontext()):
             self._labels = {}
+            self._memory_plans = {}
             self._totals = {"hits": 0, "misses": 0, "compiles": 0,
                             "compile_seconds": 0.0,
                             "persistent_cache_hits": 0,
@@ -421,6 +490,12 @@ class TrackedJit:
             dt = time.perf_counter() - t0
         self._aot[key] = compiled
         reg.record_call(self.label, "precompile", seconds=dt, signature=key)
+        plan = memory_plan_from_compiled(compiled)
+        if plan is not None:
+            # every AOT program ships its HBM plan (per-pad-bucket programs
+            # included): argument/output/temp/code bytes, queryable via the
+            # registry + telemetry without re-lowering (ISSUE 9)
+            reg.record_memory_plan(self.label, plan)
         logging.debug("precompiled %s in %.2fs", self.label, dt)
         return compiled
 

@@ -1,18 +1,26 @@
 """Device memory introspection (replaces the reference's storage manager
 stats and GraphExecutor::Print 'Total N MB allocated' — SURVEY.md §5 requires
-keeping the memcost regression story; see also Executor.debug_str)."""
+keeping the memcost regression story; see also Executor.debug_str and the
+telemetry memory layer, doc/developer-guide/telemetry.md)."""
 
 from __future__ import annotations
 
 import jax
 
-__all__ = ["memory_stats"]
+__all__ = ["memory_stats", "BASE_KEYS"]
+
+# Always-present keys (zeros when the backend exposes nothing — the CPU
+# test-rig contract): callers may key on these unconditionally.
+BASE_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
 
 
 def memory_stats(device=None) -> dict:
-    """Per-device allocator stats {bytes_in_use, peak_bytes_in_use, ...}.
+    """Per-device allocator stats.
 
-    Returns zeros when the backend doesn't expose stats (CPU test runs)."""
+    The :data:`BASE_KEYS` are always present (0 when the backend doesn't
+    expose stats — CPU test runs); every other key the backend reports
+    (``largest_alloc_size``, ``num_allocs``, pool stats, ...) passes
+    through untouched instead of being silently dropped."""
     devices = [device] if device is not None else jax.local_devices()
     out = {}
     for d in devices:
@@ -20,9 +28,5 @@ def memory_stats(device=None) -> dict:
             stats = d.memory_stats() or {}
         except Exception:
             stats = {}
-        out[str(d)] = {
-            "bytes_in_use": stats.get("bytes_in_use", 0),
-            "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
-            "bytes_limit": stats.get("bytes_limit", 0),
-        }
+        out[str(d)] = {**{k: 0 for k in BASE_KEYS}, **stats}
     return out

@@ -92,6 +92,35 @@ def test_bench_trace_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_TRACE_*
 
 
+def test_bench_mem_smoke_json_contract():
+    """--mem-bench --smoke is the CI guard on the memory-observability
+    bench entry: one JSON line with the contract keys, ledger/sampler op
+    costs measured, a live watermark recorded, at least one program plan
+    registered, and the ISSUE 9 acceptance bound — ledger + sampler
+    under 2% of the dp-8 baseline step."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mem-bench", "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "add_ns",
+                "sample_ns", "step_ms_baseline", "step_ms_tracked",
+                "tracked_overhead_pct", "watermark_mb",
+                "memory_plans_registered"):
+        assert key in blob, blob
+    assert blob["metric"] == "memory_ledger_overhead_pct_of_step"
+    assert blob["add_ns"] > 0 and blob["step_ms_baseline"] > 0
+    # the acceptance bound: memory accounting costs <2% of a step
+    assert 0 < blob["value"] < 2.0, blob
+    assert blob["watermark_mb"] > 0  # the ledger saw the tracked run
+    assert blob["memory_plans_registered"] >= 1  # AOT plan registered
+    assert blob["smoke"] is True  # smoke runs never write BENCH_MEM_*
+
+
 def test_bench_overlap_smoke_json_contract():
     """--overlap-bench --smoke is the CI guard on the comm/compute
     overlap bench entry: one JSON line with the contract keys, the

@@ -134,6 +134,16 @@ def test_jsonl_schema_golden_keys(tmp_path):
     h.emit("flight_dump", reason="manual", path="/tmp/f.json")
     h.emit("watchdog", deadline=5.0)
     h.emit("chaos", site="kvstore.push")
+    # memory-observability kinds (ISSUE 9)
+    telemetry.memory.publish_plan("train_step:abc", {
+        "argument_bytes": 1024, "output_bytes": 128, "temp_bytes": 2048,
+        "generated_code_bytes": 0, "alias_bytes": 0, "total_bytes": 2176})
+    h.emit("memory_watermark", epoch=0, watermark_bytes=4096,
+           live_bytes=2048, live_count=7)
+    h.emit("memory_leak", epoch=3, drift_bytes=1 << 20, epochs=2,
+           watermark_bytes=8 << 20)
+    h.emit("memory_preflight", what="fit", total_bytes=4096,
+           budget_bytes=None, fits=True)
     path = str(tmp_path / "events.jsonl")
     telemetry.write_jsonl(path, h.events())
     rows = telemetry.read_jsonl(path)
