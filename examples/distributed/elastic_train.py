@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Elastic training demonstration (ISSUE 10; ROADMAP item 4).
+
+An 8-virtual-device data-parallel run loses 2 workers mid-epoch, keeps
+training on the 6 survivors, and re-absorbs the capacity two epochs
+later — all in ONE process, no relaunch. The ElasticCoordinator owns
+membership; fit() polls it every step and, on a change, quiesces,
+re-shards state from the CRC-manifest checkpoints onto the new dp axis,
+re-derives the wire plans, AOT re-warms the new axis, and resumes. The
+downtime is priced into the per-epoch Goodput line as `resize` badput.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python elastic_train.py /tmp/elastic_ckpt
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu.resilience import ElasticCoordinator
+
+
+def main():
+    ckpt_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/elastic_ckpt"
+
+    rng = np.random.RandomState(0)
+    X = np.concatenate([rng.randn(240, 8) + 1.0,
+                        rng.randn(240, 8) - 1.0]).astype(np.float32)
+    y = np.concatenate([np.ones(240), np.zeros(240)]).astype(np.float32)
+    order = rng.permutation(480)  # mixed-class batches; the ITERATOR stays
+    X, y = X[order], y[order]     # unshuffled so every epoch replays bitwise
+
+    world = 8
+    co = ElasticCoordinator(world, min_world=4)
+
+    def churn(param):
+        # a real deployment calls kill() from heartbeat expiry or a
+        # kvstore MembershipTimeout; here the schedule is scripted
+        if param.epoch == 1 and param.nbatch == 3 and co.world_size == 8:
+            print(">>> losing ranks", co.kill(), "and", co.kill())
+        if param.epoch == 3 and param.nbatch == 2 and co.world_size == 6:
+            print(">>> capacity returned:", co.join_all())
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(data=net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=2)
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    model = mx.FeedForward(
+        net, ctx=[mx.cpu(i) for i in range(world)],
+        num_epoch=5, optimizer="sgd", learning_rate=0.1)
+    model.fit(mx.io.NDArrayIter(X, y, batch_size=48, shuffle=False),
+              batch_size=48, elastic=co, sharded_checkpoint_dir=ckpt_dir,
+              batch_end_callback=churn, compression="int8", overlap=True,
+              telemetry=True)
+
+    print("resizes:", co.resizes)
+    for h in co.history:
+        print(f"  {h['from']} -> {h['to']}  downtime {h['downtime_s']:.2f}s"
+              f"  ({h['reason']})")
+    print("final accuracy:", model.score(X, y=y))
+
+
+if __name__ == "__main__":
+    main()

@@ -198,6 +198,20 @@ register_rule(
     "`# mxlint: disable=MX309` and a justification")
 
 register_rule(
+    "MX310", "warning",
+    "world-size/axis-size literal captured in a closure: a nested "
+    "function closes over a variable bound to an integer literal whose "
+    "name says world/axis size (world_size, num_workers, axis_size, "
+    "ndev, num_devices, n_workers, n_devices, nproc) — under elastic "
+    "training (ISSUE 10) the world resizes mid-run, and a size frozen "
+    "into a closure at build time silently keeps describing the dead "
+    "world after a resize",
+    "derive the size where it is used (int(mesh.shape['dp']), "
+    "kv.num_workers, coordinator.world_size) or pass it as an argument "
+    "from the mesh/coordinator provider so every (re)build of the "
+    "closure sees the current world")
+
+register_rule(
     "MX306", "warning",
     "un-barriered wall-clock delta around device dispatch: a "
     "time.time()/perf_counter() start/stop pair with work between and no "

@@ -19,6 +19,10 @@ Catches, before anything imports or traces:
                a loop that dispatches the train/eval/predict step — each
                pull serializes async dispatch and skews memory accounting
                (intentional per-step syncs carry a disable pragma),
+  MX310        world-size/axis-size integer literals captured in closures
+               outside the mesh/coordinator providers — a size frozen at
+               build time goes stale when the elastic world resizes
+               mid-run (derive from the live mesh/kvstore/coordinator),
   MX601-602    robustness hazards (bare ``except:``; ``while True`` retry
                loops that swallow exceptions with no backoff/deadline —
                the loop shape that melts a parameter server under a
@@ -759,6 +763,85 @@ def _scan_step_loop_syncs(tree, path, imports, findings):
                     path=path, line=call.lineno, col=call.col_offset))
 
 
+# -- MX310: world-size literals frozen into closures --------------------------
+# The elastic-staleness bug class (ISSUE 10): `ndev = 8` in an outer scope,
+# captured by a nested step/placement function — after a mid-run resize the
+# closure keeps computing with the dead world's size. The scan is
+# function-local and zero-FP-biased: it fires only when (a) an enclosing
+# function binds a world/axis-size-NAMED variable to an INTEGER LITERAL and
+# (b) a nested def/lambda reads that name as a free variable. Sizes derived
+# from live objects (`int(mesh.shape["dp"])`, `kv.num_workers`,
+# `coordinator.world_size`) are call results, not literals, so the healthy
+# idiom never flags. The mesh/coordinator providers themselves
+# (parallel/mesh.py, resilience/elastic.py) are exempt — defining the world
+# is their job.
+
+_WORLD_SIZE_NAMES = frozenset({
+    "world_size", "num_workers", "axis_size", "ndev", "num_devices",
+    "n_workers", "n_devices", "nproc"})
+_MX310_EXEMPT_FILES = ("mesh.py", "elastic.py")
+
+
+def _scan_world_literal_closures(tree, path, findings):
+    base = os.path.basename(os.path.normpath(path))
+    if base in _MX310_EXEMPT_FILES:
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # world-size names this scope binds to plain integer literals
+        # (only statements local to fn — nested defs are their own scope)
+        literal_bound = {}
+        for node in _iter_local_nodes(fn):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets if isinstance(t, ast.Name)]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if not (isinstance(value, ast.Constant)
+                    and type(value.value) is int):
+                continue
+            for t in targets:
+                if t.id.lower() in _WORLD_SIZE_NAMES:
+                    literal_bound[t.id] = node.lineno
+        if not literal_bound:
+            continue
+        for nested in ast.walk(fn):
+            if nested is fn or not isinstance(
+                    nested, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+                continue
+            a = nested.args
+            bound_inner = {p.arg for p in a.args + a.posonlyargs
+                           + a.kwonlyargs}
+            if a.vararg is not None:
+                bound_inner.add(a.vararg.arg)
+            if a.kwarg is not None:
+                bound_inner.add(a.kwarg.arg)
+            for sub in ast.walk(nested):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Store):
+                    bound_inner.add(sub.id)
+            for sub in ast.walk(nested):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Load) and \
+                        sub.id in literal_bound and \
+                        sub.id not in bound_inner:
+                    findings.append(Finding(
+                        get_rule("MX310"),
+                        f"closure captures `{sub.id}` bound to an integer "
+                        f"literal at line {literal_bound[sub.id]} — a "
+                        f"world/axis size frozen at build time goes stale "
+                        f"when the elastic world resizes",
+                        path=path, line=sub.lineno, col=sub.col_offset))
+                    break  # one finding per closure is enough
+
+
 # -- MX308: unpinned wire collectives in comm/ --------------------------------
 # The convert-commuting bug class documented at comm/allreduce.py
 # (_exchange): converting before/after pure data movement is elementwise-
@@ -928,6 +1011,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     _scan_leaked_spans(tree, path, scan.findings)
     _scan_unpinned_collectives(tree, path, scan.findings)
     _scan_step_loop_syncs(tree, path, scan.imports, scan.findings)
+    _scan_world_literal_closures(tree, path, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)
     roots += [d for d in scan.defs if d.name in scan.traced_names]

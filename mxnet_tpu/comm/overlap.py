@@ -174,6 +174,20 @@ class OverlapPlan:
 
         return overlap_plan(self.bucket_elems(), self.axis_size, self.spec)
 
+    def replan(self, axis_size) -> "OverlapPlan":
+        """The same parameter set, schedule, and compression on a
+        different axis size (elastic resize). Bucket membership and order
+        are topology-independent — only the per-bucket padded lengths
+        (reduce-scatter rows) and the layout key change, which is exactly
+        why a resize invalidates checkpointed residuals: the new plan's
+        ``layout_key()`` differs and ``residuals_match_plan`` rejects the
+        old ``(old_axis, Lp)`` ledgers."""
+        axis_size = int(axis_size)
+        buckets = [{**b, "padded": padded_flat_size(b["size"], self.spec,
+                                                    axis_size)}
+                   for b in self.buckets]
+        return OverlapPlan(self.spec, axis_size, buckets)
+
     def __repr__(self):
         return (f"OverlapPlan(mode={self.spec.mode!r}, "
                 f"axis_size={self.axis_size}, buckets={self.num_buckets})")

@@ -380,10 +380,31 @@ class _GroupServer:
     double-counting — a duplicate parks until the round it already
     contributed to is released, then returns like the original would have.
     Anonymous pushes (no worker id) keep the legacy accumulate-everything
-    semantics."""
+    semantics.
 
-    def __init__(self, num_workers):
+    Elastic membership (ISSUE 10): ``num_workers`` is dynamic.
+    ``deregister_worker`` removes a dead/leaving worker — the membership
+    epoch bumps and every OPEN accumulate/barrier round is re-evaluated
+    against the new world, so survivors blocked on the dead worker's
+    contribution release instead of hanging; ``register_worker`` readmits
+    one (rejoin handshake: register between rounds, then pull + barrier).
+    Every collective wait can additionally carry a per-op deadline
+    (``op_timeout``, env ``MXNET_TPU_KV_OP_TIMEOUT``; OFF by default —
+    legitimate stragglers in a fixed-world job may outwait anything, so
+    only elastic deployments opt in): a round that stalls past it raises
+    :class:`resilience.elastic.MembershipTimeout` — the hang is promoted
+    to a *detected membership change* the caller hands to the
+    ElasticCoordinator, instead of a silent stall."""
+
+    def __init__(self, num_workers, op_timeout=None):
         self.num_workers = num_workers
+        if op_timeout is None:
+            import os
+
+            raw = os.environ.get("MXNET_TPU_KV_OP_TIMEOUT", "").strip()
+            op_timeout = float(raw) if raw else 0.0
+        self.op_timeout = op_timeout or None  # 0 -> no deadline
+        self.membership_epoch = 0
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.store: dict = {}
@@ -396,12 +417,82 @@ class _GroupServer:
         self.duplicate_count = 0
         self._barrier_count = 0
         self._barrier_round = 0
+        self._left: set = set()  # deregistered workers (idempotence)
         # per-pushing-thread collective-wait seconds (one thread per
         # worker in the group harness — must not share across pushers)
         self._wait_tls = threading.local()
         # compressed-push accounting: what arrived vs what fp32 would cost
         self.wire_bytes_received = 0
         self.raw_bytes_received = 0
+
+    # -- elastic membership (ISSUE 10) ----------------------------------------
+    def _timeout(self, what):
+        from .resilience.elastic import MembershipTimeout
+
+        raise MembershipTimeout(
+            f"kvstore {what} stalled past {self.op_timeout}s at membership "
+            f"epoch {self.membership_epoch} with {self.num_workers} "
+            f"worker(s) expected — presumed dead worker: deregister it "
+            f"(ElasticCoordinator.kill + deregister_worker) and resize",
+            membership_epoch=self.membership_epoch)
+
+    def _maybe_release_key_locked(self, key):
+        """Release ``key``'s open accumulate round once every CURRENT
+        member has contributed. ``>=`` not ``==``: a worker that pushed
+        and then deregistered still counts — its gradients arrived."""
+        if not 0 < self.num_workers <= self._count.get(key, 0):
+            return False
+        merged = self._accum[key]
+        if self.updater is not None:
+            self.updater(key, merged, self.store[key])
+        else:
+            self.store[key] = merged.copy()
+        self._count[key] = 0
+        self._contrib[key] = set()
+        self._round[key] = self._round.get(key, 0) + 1
+        self.cv.notify_all()
+        return True
+
+    def _maybe_release_barrier_locked(self):
+        if not 0 < self.num_workers <= self._barrier_count:
+            return False
+        self._barrier_count = 0
+        self._barrier_round += 1
+        self.cv.notify_all()
+        return True
+
+    def deregister_worker(self, worker):
+        """Remove a dead/leaving worker: the membership epoch bumps and
+        every open accumulate/barrier round re-evaluates against the
+        shrunk world, so survivors blocked on this worker's contribution
+        release instead of hanging. Idempotent; returns the new epoch."""
+        with self.cv:
+            if worker in self._left or self.num_workers <= 0:
+                return self.membership_epoch
+            self._left.add(worker)
+            self.num_workers -= 1
+            self.membership_epoch += 1
+            for key in list(self._accum):
+                self._maybe_release_key_locked(key)
+            self._maybe_release_barrier_locked()
+            self.cv.notify_all()
+            return self.membership_epoch
+
+    def register_worker(self, worker):
+        """Readmit a worker (the rejoin handshake: register between
+        rounds, then have the worker pull fresh weights and barrier —
+        open rounds now expect its contribution). Idempotent: only a
+        worker that actually left re-inflates the count (a doubled
+        register would otherwise leave num_workers above the real pusher
+        count and wedge every later round). Returns the new epoch."""
+        with self.cv:
+            if worker not in self._left:
+                return self.membership_epoch
+            self._left.discard(worker)
+            self.num_workers += 1
+            self.membership_epoch += 1
+            self.cv.notify_all()
+            return self.membership_epoch
 
     def _decode_value(self, key, value):
         """Workers with compression armed push ('enc', spec-args, payload)
@@ -455,13 +546,17 @@ class _GroupServer:
         """The BSP accumulate/release protocol; True = duplicate resend
         (absorbed, not double-counted). Time spent blocked in cv.wait_for
         (waiting on the rest of the round, not handling this push) lands
-        in the calling thread's ``self._wait_tls.s``."""
+        in the calling thread's ``self._wait_tls.s``. Waits carry the
+        per-op deadline: a round stalled past it (dead worker, nobody
+        deregistered) raises MembershipTimeout instead of hanging."""
         self._wait_tls.s = 0.0
 
-        def _wait(predicate):
+        def _wait(predicate, what):
             t = time.monotonic()
-            self.cv.wait_for(predicate)
+            ok = self.cv.wait_for(predicate, timeout=self.op_timeout)
             self._wait_tls.s += time.monotonic() - t
+            if not ok:
+                self._timeout(what)
 
         with self.cv:
             value = self._decode_value(key, value)
@@ -474,14 +569,16 @@ class _GroupServer:
                     # completed round): wait for ITS round, not the open one
                     self.duplicate_count += 1
                     applied_round = prev[1]
-                    _wait(lambda: self._round.get(key, 0) > applied_round)
+                    _wait(lambda: self._round.get(key, 0) > applied_round,
+                          f"push[{key}] resend round {applied_round}")
                     return True
                 contrib = self._contrib.setdefault(key, set())
                 if worker in contrib:
                     # same-round duplicate without a usable seq: already
                     # counted; park until the open round releases
                     self.duplicate_count += 1
-                    _wait(lambda: self._round.get(key, 0) > my_round)
+                    _wait(lambda: self._round.get(key, 0) > my_round,
+                          f"push[{key}] duplicate round {my_round}")
                     return True
                 contrib.add(worker)
                 self._applied[(key, worker)] = (seq, my_round)
@@ -491,18 +588,9 @@ class _GroupServer:
             else:
                 self._accum[key] += value
                 self._count[key] += 1
-            if self._count[key] == self.num_workers:
-                merged = self._accum[key]
-                if self.updater is not None:
-                    self.updater(key, merged, self.store[key])
-                else:
-                    self.store[key] = merged.copy()
-                self._count[key] = 0
-                self._contrib[key] = set()
-                self._round[key] = my_round + 1
-                self.cv.notify_all()
-            else:
-                _wait(lambda: self._round.get(key, 0) > my_round)
+            if not self._maybe_release_key_locked(key):
+                _wait(lambda: self._round.get(key, 0) > my_round,
+                      f"push[{key}] round {my_round}")
             return False
 
     def pull(self, key, trace=None) -> np.ndarray:
@@ -518,15 +606,21 @@ class _GroupServer:
         return value
 
     def barrier(self):
+        """Membership-epoch-tagged barrier round: released when every
+        CURRENT member arrived (a deregistration mid-round re-evaluates
+        the count), raises MembershipTimeout past the per-op deadline —
+        this waiter's arrival is withdrawn so a later retry can't count
+        twice."""
         with self.cv:
             my_round = self._barrier_round
             self._barrier_count += 1
-            if self._barrier_count == self.num_workers:
-                self._barrier_count = 0
-                self._barrier_round += 1
-                self.cv.notify_all()
-            else:
-                self.cv.wait_for(lambda: self._barrier_round > my_round)
+            if self._maybe_release_barrier_locked():
+                return
+            ok = self.cv.wait_for(lambda: self._barrier_round > my_round,
+                                  timeout=self.op_timeout)
+            if not ok:
+                self._barrier_count = max(self._barrier_count - 1, 0)
+                self._timeout(f"barrier round {my_round}")
 
 
 class _GroupWorkerKVStore(KVStore):
@@ -686,15 +780,20 @@ def create(kv_type="local") -> KVStore:
     return store
 
 
-def create_group(num_workers: int, kv_type="dist_sync", compression=None):
+def create_group(num_workers: int, kv_type="dist_sync", compression=None,
+                 op_timeout=None):
     """N worker handles sharing one BSP server (single-host stand-in for the
     reference's `dmlc_local.py -n N` multi-process launcher; run each handle
     from its own thread). ``compression`` arms quantized pushes on every
     worker (each keeps its own error-feedback residuals; the server
-    decodes and accumulates in f32 — see set_gradient_compression)."""
+    decodes and accumulates in f32 — see set_gradient_compression).
+    ``op_timeout`` bounds every collective wait (default env
+    ``MXNET_TPU_KV_OP_TIMEOUT``): a round stalled past it raises
+    MembershipTimeout — the elastic layer's detected-membership-change
+    signal — instead of hanging the group forever."""
     if kv_type not in ("dist_sync", "dist"):
         raise MXNetError("create_group supports dist_sync semantics")
-    server = _GroupServer(num_workers)
+    server = _GroupServer(num_workers, op_timeout=op_timeout)
     workers = [_GroupWorkerKVStore(server, r) for r in range(num_workers)]
     if compression is not None:
         for w in workers:

@@ -29,7 +29,9 @@ float data crosses the socket as raw frames (sent straight from the
 array's memoryview, received with a single ``np.frombuffer``), never
 through the pickler. Ops: init / push / pull / push_many / pull_many /
 push_pull (apply grads + return updated weights, the trainer's
-one-round-trip batch sync) / set_optimizer / barrier / stop.
+one-round-trip batch sync) / set_optimizer / barrier / leave / join
+(elastic membership: resize the expected world, tag rounds with a
+membership epoch) / stop.
 
 The parameter-host port is OS-assigned by the launcher at job start and
 published to every process via ``MXTPU_ASYNC_PORT`` (tools/launch.py);
@@ -183,6 +185,20 @@ class _AsyncServer:
         self.cv = threading.Condition(self.lock)
         self._barrier_count = 0
         self._barrier_round = 0
+        # elastic membership (ISSUE 10): "leave"/"join" ops resize the
+        # expected world; the epoch tags barrier rounds so a mid-round
+        # change re-evaluates the count instead of stranding survivors,
+        # and an OPT-IN per-op deadline (MXNET_TPU_KV_OP_TIMEOUT; unset =
+        # the legacy outwait-any-straggler semantics) promotes a stall
+        # (dead worker, nobody told us) to an error the client turns
+        # into a detected membership change
+        self._membership_epoch = 0
+        # rank-set membership (launcher contract: initial ranks are
+        # 0..n-1): leave/join of a NAMED rank are set operations, so two
+        # survivors reporting the same dead worker shrink the world ONCE
+        self._members = set(range(num_workers))
+        _raw_t = os.environ.get("MXNET_TPU_KV_OP_TIMEOUT", "").strip()
+        self._op_timeout = (float(_raw_t) if _raw_t else 0.0) or None
         self._stopped = 0
         self._compression = None   # last armed spec (informational; *_enc
                                    # requests carry their own spec)
@@ -467,7 +483,8 @@ class _AsyncServer:
                     "duplicate_count": self.duplicate_count,
                     "num_workers": self.num_workers,
                     "keys": len(self.store),
-                    "barrier_round": self._barrier_round}))
+                    "barrier_round": self._barrier_round,
+                    "membership_epoch": self._membership_epoch}))
         elif op == "trace":
             # fleet trace identity, first-write-wins: every worker OFFERS
             # its id and adopts the canonical reply, so the fleet shares
@@ -496,18 +513,81 @@ class _AsyncServer:
             with self.lock:
                 self.updater = wrap_np_updater(get_updater(opt))
             _send_msg(conn, ("ok",))
+        elif op in ("leave", "join"):
+            # elastic membership: resize the expected world. ``leave`` is
+            # both the graceful-departure and the detected-death path (the
+            # coordinator calls it for a worker that stopped answering);
+            # ``join`` is the rejoin handshake — the reply carries the new
+            # world + epoch + current key set so the rejoiner knows what
+            # to pull before it barriers back in. Membership ops are NOT
+            # idempotent (a doubled leave shrinks the world twice), so
+            # they ride the (rank, seq) replay cache like every other
+            # mutating request: a retried resend is answered from cache.
+            rank = msg[1] if len(msg) > 1 else None
+            ident = tuple(msg[2:4]) if len(msg) >= 4 else None
+            if self._replay(conn, ident):
+                return False
+            with self.cv:
+                before = self.num_workers
+                if rank is None:
+                    # anonymous (legacy) form: pure count arithmetic
+                    self.num_workers = max(
+                        self.num_workers + (1 if op == "join" else -1), 0)
+                else:
+                    # named rank: a SET operation — two survivors both
+                    # reporting the same dead worker shrink the world
+                    # once, and a doubled rejoin cannot inflate it
+                    rank = int(rank)
+                    if op == "leave":
+                        self._members.discard(rank)
+                    else:
+                        self._members.add(rank)
+                    self.num_workers = len(self._members)
+                if self.num_workers != before:
+                    self._membership_epoch += 1
+                    # a shrunk world may already satisfy the open round
+                    if op == "leave" and \
+                            0 < self.num_workers <= self._barrier_count:
+                        self._barrier_count = 0
+                        self._barrier_round += 1
+                    self.cv.notify_all()
+                out = {"num_workers": self.num_workers,
+                       "membership_epoch": self._membership_epoch,
+                       "rank": rank,
+                       "keys": sorted(self.store) if op == "join" else None}
+            reply = ("ok", out)
+            self._record(ident, reply)
+            _send_msg(conn, reply)
         elif op == "barrier":
+            timed_out = False
             with self.cv:
                 my_round = self._barrier_round
+                epoch0 = self._membership_epoch
                 self._barrier_count += 1
-                if self._barrier_count == self.num_workers:
+                if 0 < self.num_workers <= self._barrier_count:
                     self._barrier_count = 0
                     self._barrier_round += 1
                     self.cv.notify_all()
                 else:
-                    self.cv.wait_for(
-                        lambda: self._barrier_round > my_round)
-            _send_msg(conn, ("ok",))
+                    ok = self.cv.wait_for(
+                        lambda: self._barrier_round > my_round,
+                        timeout=self._op_timeout)
+                    if not ok:
+                        # withdraw this arrival so a later retry can't
+                        # count twice, then promote the stall to a
+                        # detectable membership-change error
+                        self._barrier_count = max(
+                            self._barrier_count - 1, 0)
+                        timed_out = True
+            if timed_out:
+                _send_msg(conn, (
+                    "err",
+                    f"membership: barrier round {my_round} stalled past "
+                    f"{self._op_timeout}s at membership epoch {epoch0} "
+                    f"({self.num_workers} worker(s) expected) — presumed "
+                    f"dead worker; shrink the group with the leave op"))
+            else:
+                _send_msg(conn, ("ok",))
         elif op == "stop":
             with self.lock:
                 self._stopped += 1
@@ -905,10 +985,43 @@ class AsyncKVStore(KVStore):
                    pickle.dumps(optimizer, protocol=pickle.HIGHEST_PROTOCOL))
 
     def barrier(self):
-        # arrival-counted on the server: a resend would count twice, and a
-        # legitimate barrier can outwait any timeout (stragglers) — so no
-        # retry and no deadline
-        self._call("barrier", retry=False, timeout=None)
+        # arrival-counted on the server: a resend would count twice, so no
+        # retry and no client deadline — the SERVER bounds the round
+        # (MXNET_TPU_KV_OP_TIMEOUT) and answers a stalled one with a
+        # membership error, which surfaces here as MembershipTimeout: the
+        # hang is promoted to a detected membership change the elastic
+        # coordinator can act on
+        try:
+            self._call("barrier", retry=False, timeout=None)
+        except MXNetError as e:
+            if "membership:" not in str(e):
+                raise
+            from .resilience.elastic import MembershipTimeout
+
+            raise MembershipTimeout(str(e)) from None
+
+    # -- elastic membership (ISSUE 10) ----------------------------------------
+    def leave_group(self, rank=None):
+        """Tell the parameter host a worker is leaving — this one by
+        default, or a dead one the caller detected (pass its rank). The
+        expected world shrinks, the membership epoch bumps, and any
+        barrier round the departure completes is released. Departure is
+        a rank-SET operation on the server, so several survivors
+        reporting the same dead worker shrink the world once; the
+        (rank, seq) wire identity additionally dedups retried resends.
+        Returns {num_workers, membership_epoch, ...}."""
+        return self._call("leave",
+                          self._rank if rank is None else int(rank),
+                          mutating=True)
+
+    def rejoin_group(self, rank=None):
+        """Rejoin handshake: grow the expected world and learn what to
+        pull. Returns {num_workers, membership_epoch, keys} — the caller
+        pulls the listed keys for fresh weights, then barriers back in.
+        Set-idempotent and resend-deduped, like leave_group."""
+        return self._call("join",
+                          self._rank if rank is None else int(rank),
+                          mutating=True)
 
     def stats(self) -> dict:
         """Server-side health counters, fetched over the wire and mirrored

@@ -910,7 +910,7 @@ class FeedForward(BASE_ESTIMATOR):
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, batch_size=128,
             sharded_checkpoint_dir=None, guards=None, pad_policy=None,
-            compression=None, overlap=None, telemetry=None):
+            compression=None, overlap=None, telemetry=None, elastic=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -981,7 +981,26 @@ class FeedForward(BASE_ESTIMATOR):
         ``.dump_jsonl(path)``). Exact device timing blocks on each step's
         outputs — that trades feed/compute overlap for attribution
         (doc/developer-guide/telemetry.md); ``TelemetryConfig(sync=False)``
-        keeps the overlap."""
+        keeps the overlap.
+
+        ``elastic``: mid-run world resizing — None (default; env gate
+        ``MXNET_TPU_ELASTIC``), True, or a
+        resilience.elastic.ElasticCoordinator (pass your own to drive
+        kills/joins from callbacks or heartbeats). When armed, the loop
+        polls the coordinator once per step; on a membership change it
+        quiesces the in-flight step, re-shards params/optimizer state
+        from the newest CRC-manifest checkpoint onto the new ``dp`` axis
+        (error-feedback residuals survive only when their layout key
+        still matches — a changed axis drops them safely), re-derives the
+        overlap/bucket wire plans, re-runs AOT warmup for the new axis
+        through TrackedJit (growing back to a seen axis reuses the
+        still-warm executables), and resumes in the same process — the
+        interrupted epoch is redone on the new world, the same
+        epoch-granular contract as preemption resume. Requires
+        ``sharded_checkpoint_dir`` and a multi-device ctx list; downtime
+        is priced into goodput as a ``resize`` badput bucket and appears
+        in traces as coordinator spans
+        (doc/developer-guide/resilience.md, "Elastic training")."""
         del work_load_list
         guard_cfg = guards_mod.GuardConfig.resolve(guards)
         pad_policy = compile_mod.PadPolicy.resolve(pad_policy)
@@ -1125,6 +1144,49 @@ class FeedForward(BASE_ESTIMATOR):
                 kv.init(name, self.arg_params[name])
             self._async_pull_params(kv, param_names)
 
+        # -- elastic membership (ISSUE 10): resize the virtual-device dp
+        # world mid-run (doc/developer-guide/resilience.md) ----------------
+        from .resilience import elastic as elastic_mod
+
+        elastic_co = elastic_mod.ElasticCoordinator.resolve(
+            elastic, len(self.ctx))
+        elastic_base_ctx = list(self.ctx)  # rank r -> its device, forever
+        if elastic_co is not None:
+            if mesh is None:
+                raise MXNetError(
+                    "elastic= needs a multi-device world: give fit a ctx "
+                    "list spanning the devices the dp axis may resize over")
+            if async_kv or num_workers > 1:
+                raise MXNetError(
+                    "elastic= resizes the virtual-device dp world; "
+                    "multi-process worker membership is the kvstore "
+                    "layer's job (membership epochs + leave/join ops)")
+            if sharded_checkpoint_dir is None:
+                raise MXNetError(
+                    "elastic= needs sharded_checkpoint_dir: a resize "
+                    "re-shards optimizer state and EF residuals from the "
+                    "CRC-manifest checkpoints")
+            if elastic_co.full_world_size != int(mesh.shape["dp"]):
+                raise MXNetError(
+                    f"elastic coordinator world "
+                    f"({elastic_co.full_world_size}) does not match the "
+                    f"dp axis size ({int(mesh.shape['dp'])})")
+            if elastic_co.min_world < 2:
+                raise MXNetError(
+                    "elastic= needs min_world >= 2: a resize must leave a "
+                    "multi-device dp mesh to rebuild (single-device "
+                    "training has no axis to reshard onto)")
+            # virtual-world identity: hub events/metrics carry the dp
+            # world size so post-resize streams are relabeled correctly
+            # (restored on exit — the process identity must not keep
+            # quoting this run's world after fit returns)
+            elastic_prev_world = (telemetry_mod.current_rank(),
+                                  telemetry_mod.world_size())
+            telemetry_mod.set_world(elastic_prev_world[0],
+                                    int(mesh.shape["dp"]))
+            telemetry_mod.gauge("elastic_world_size",
+                                float(int(mesh.shape["dp"])))
+
         # device-resident training state (f32 master params). dist_async
         # keeps NO worker-side optimizer state: the server owns it
         # (update-on-kvstore), so a momentum tree here would be dead HBM.
@@ -1150,45 +1212,53 @@ class FeedForward(BASE_ESTIMATOR):
         # Under the overlap schedule this is a dict of per-bucket ledgers;
         # either shape is checkpointed with a layout key, and a resumed
         # run only reuses saved residuals that still describe its buckets.
-        cstate = None
-        resid_layout_key = None
-        if comm_spec is not None and comm_spec.error_feedback:
+        def _build_comm_state(saved_state, saved_layout):
+            """(cstate, layout_key) for the CURRENT mesh/plan: fresh EF
+            residual ledgers, or the saved ones when their layout key and
+            shapes still describe this world's buckets. Checkpoint resume
+            and elastic resize share this decision — a changed axis size
+            changes the layout key, so stale residuals (rows laid out for
+            the old world) are dropped safely instead of cross-injected."""
+            if comm_spec is None or not comm_spec.error_feedback:
+                return None, None
             ndev = int(mesh.shape["dp"])
             if overlap_plan is not None:
                 resid = comm_mod.init_overlap_residuals(overlap_plan)
-                resid_layout_key = overlap_plan.layout_key()
-                if resume_comm_state is not None:
-                    if resume_comm_layout == resid_layout_key and \
-                            comm_mod.residuals_match_plan(resume_comm_state,
+                layout_key = overlap_plan.layout_key()
+                if saved_state is not None:
+                    if saved_layout == layout_key and \
+                            comm_mod.residuals_match_plan(saved_state,
                                                           overlap_plan):
-                        resid = {k: jnp.asarray(v)
-                                 for k, v in resume_comm_state.items()}
+                        resid = {k: jnp.asarray(np.asarray(v))
+                                 for k, v in saved_state.items()}
                         logger.info("resumed %d per-bucket EF residual "
                                     "ledger(s)", len(resid))
                     else:
                         logger.info(
                             "EF residuals dropped on resume: bucket plan "
                             "changed (%s -> %s); starting a fresh ledger",
-                            resume_comm_layout, resid_layout_key)
+                            saved_layout, layout_key)
             else:
                 resid = optimizer.init_comm_residual(
                     params, comm_spec, ndev)
-                resid_layout_key = comm_mod.fused_layout_key(
+                layout_key = comm_mod.fused_layout_key(
                     comm_mod.flat_size(params), comm_spec, ndev)
-                if resume_comm_state is not None:
-                    saved = resume_comm_state.get("__fused__")
-                    if resume_comm_layout == resid_layout_key and \
+                if saved_state is not None:
+                    saved = saved_state.get("__fused__")
+                    if saved_layout == layout_key and \
                             saved is not None and \
                             tuple(saved.shape) == tuple(resid.shape):
-                        resid = jnp.asarray(saved)
+                        resid = jnp.asarray(np.asarray(saved))
                         logger.info("resumed fused EF residual")
                     else:
                         logger.info(
                             "EF residual dropped on resume: layout changed "
-                            "(%s -> %s)", resume_comm_layout,
-                            resid_layout_key)
-            cstate = {"resid": jax.device_put(
-                resid, NamedSharding(mesh, P("dp")))}
+                            "(%s -> %s)", saved_layout, layout_key)
+            return {"resid": jax.device_put(
+                resid, NamedSharding(mesh, P("dp")))}, layout_key
+
+        cstate, resid_layout_key = _build_comm_state(resume_comm_state,
+                                                     resume_comm_layout)
 
         # -- resilience wiring (all of it no-op when guards are off and no
         # checkpoint dir is given; the unguarded hot path is unchanged) ----
@@ -1235,20 +1305,30 @@ class FeedForward(BASE_ESTIMATOR):
                 arrays["__num_valid__"] = np.int32(num_valid)
             return arrays
 
-        if mesh is None:
-            _feed_dev = self.ctx[0].jax_device
+        def _make_place_batch(mesh_):
+            """Batch placement bound to ONE mesh; an elastic resize swaps
+            in a fresh closure for the new mesh (a captured sharding
+            would silently keep feeding the dead world — the staleness
+            class mxlint MX310 flags)."""
+            if mesh_ is None:
+                _feed_dev = self.ctx[0].jax_device
 
-            def _place_batch(arrays):
-                return {k: _to_dev(v, _feed_dev) for k, v in arrays.items()}
-        else:
-            _feed_sh = NamedSharding(mesh, P("dp"))
-            _feed_repl = NamedSharding(mesh, P())
+                def _pb(arrays):
+                    return {k: _to_dev(v, _feed_dev)
+                            for k, v in arrays.items()}
+            else:
+                _feed_sh = NamedSharding(mesh_, P("dp"))
+                _feed_repl = NamedSharding(mesh_, P())
 
-            def _place_batch(arrays):
-                # scalars (the pad-policy valid count) replicate; real batch
-                # arrays shard on dp
-                return {k: _place(v, _feed_sh if np.ndim(v) else _feed_repl)
-                        for k, v in arrays.items()}
+                def _pb(arrays):
+                    # scalars (the pad-policy valid count) replicate; real
+                    # batch arrays shard on dp
+                    return {k: _place(v, _feed_sh if np.ndim(v)
+                                      else _feed_repl)
+                            for k, v in arrays.items()}
+            return _pb
+
+        _place_batch = _make_place_batch(mesh)
 
         feed_depth = int(os.environ.get("MXTPU_FEED_PREFETCH", "2"))
 
@@ -1381,9 +1461,118 @@ class FeedForward(BASE_ESTIMATOR):
                 f"{sharded_checkpoint_dir is not None})",
                 step=epoch, epoch=epoch)
 
+        resize_badput = 0.0  # seconds of the current epoch lost to resizes
+
+        def _apply_resize(ev):
+            """Commit a polled membership change: quiesce -> re-shard from
+            the CRC-manifest checkpoint onto the new dp axis -> re-derive
+            the wire plans -> AOT re-warm the new axis's programs -> let
+            the loop redo the interrupted epoch on the new world. The
+            whole downtime lands in the timeline as a coordinator span
+            (kind="resize") and in goodput as ``resize`` badput."""
+            nonlocal mesh, params, opt_state, aux, gstate, cstate, \
+                resid_layout_key, overlap_plan, num_update, _place_batch
+            from .utils import checkpoint as ckpt_mod
+
+            t0 = time.time()
+            new_size = ev.world_size
+            if batch_size % new_size:
+                raise MXNetError(
+                    f"elastic resize to {new_size} worker(s) impossible: "
+                    f"global batch {batch_size} is not divisible by the "
+                    f"new dp axis — pick a batch divisible by every world "
+                    f"size the job may shrink to")
+            rspan = tl.begin_step(epoch, elastic_co.resizes, kind="resize") \
+                if tl is not None else None
+            try:
+                # quiesce: the in-flight step retires before its world dies
+                jax.block_until_ready(jax.tree_util.tree_leaves(params)[:1])
+                elastic_co.commit(ev, logger=logger)
+                self.ctx = [elastic_base_ctx[r] for r in ev.ranks]
+                mesh = self._make_mesh(dist=False)
+                # re-shard: params/aux land replicated on the NEW mesh
+                # straight from the newest CRC-valid checkpoint; optimizer
+                # leaves re-thread through this optimizer's treedef
+                loaded, laux, _, meta, opt_leaves, comm_saved = \
+                    ckpt_mod.load_resharded(sharded_checkpoint_dir, mesh)
+                params = {k: loaded[k] for k in param_names}
+                aux = {k: laux[k] for k in aux_names}
+                opt_state = optimizer.init_state_tree(params)
+                if opt_leaves is not None:
+                    flat, treedef = jax.tree_util.tree_flatten(opt_state)
+                    if len(flat) == len(opt_leaves):
+                        opt_state = jax.tree_util.tree_unflatten(
+                            treedef,
+                            [jnp.asarray(np.asarray(leaf))
+                             for leaf in opt_leaves])
+                num_update = int(meta.get("num_update", num_update))
+                if guard_cfg is not None:
+                    gstate = guards_mod.init_guard_state(
+                        guard_cfg, scale=meta.get("loss_scale"))
+                    # the rolled-back on-device skip counter restarts at 0
+                    self.guard_stats["skipped_steps"] = 0
+                # wire plans re-derive for the new axis; EF residuals
+                # survive only if their layout key still matches (an axis
+                # change never does — _build_comm_state drops them)
+                if overlap_plan is not None:
+                    overlap_plan = overlap_plan.replan(int(mesh.shape["dp"]))
+                cstate, resid_layout_key = _build_comm_state(
+                    comm_saved, meta.get("comm_layout"))
+                train_steps.clear()
+                _place_batch = _make_place_batch(mesh)
+                if mfu_acct is not None:
+                    mfu_acct.set_num_devices(int(mesh.shape["dp"]))
+                # AOT re-warmup through TrackedJit: the new axis's fused
+                # step compiles NOW, not on the first post-resize batch;
+                # growing back to a previously-seen axis finds the old
+                # world's programs still warm (precompile is idempotent
+                # per signature) and pays nothing
+                self.precompile(
+                    data_shapes=data_shapes, label_shapes=label_shapes,
+                    eval_metric=eval_metric, guards=guard_cfg,
+                    pad_policy=pad_policy, compression=comm_spec,
+                    overlap=overlap_cfg,
+                    batch_end_callback=batch_end_callback)
+            finally:
+                if rspan is not None:
+                    rspan.end()
+            down = time.time() - t0
+            elastic_co.record_downtime(down)
+            logger.info(
+                "elastic: redoing epoch %d on %d device(s) after %.2fs "
+                "resize (ranks %s, checkpoint step %s, %d update(s))",
+                epoch, int(mesh.shape["dp"]), down, list(ev.ranks),
+                meta.get("step", "?"), num_update)
+
+        if elastic_co is not None:
+            from .utils import checkpoint as ckpt_mod
+
+            if ckpt_mod.latest_step(sharded_checkpoint_dir) is None:
+                # a first-epoch membership change needs a reshard source:
+                # persist the starting state as the floor checkpoint
+                comm_state, comm_meta = _comm_ckpt()
+                ckpt_mod.save_sharded(
+                    sharded_checkpoint_dir, epoch, params, aux=aux,
+                    symbol=self.symbol, opt_state=opt_state,
+                    comm_state=comm_state,
+                    extra_meta={"epoch": epoch, "num_update": num_update,
+                                **_guard_meta(), **comm_meta})
+
         try:
-          for epoch in range(self.begin_epoch, self.num_epoch or 1):
-            tic = time.time()
+          final_epoch = self.num_epoch or 1
+          epoch = self.begin_epoch
+          epoch_tic = None
+          while epoch < final_epoch:
+            # the epoch clock survives an elastic redo: on resize the
+            # loop `continue`s without advancing `epoch` or resetting the
+            # clock, so the aborted attempt + downtime price into this
+            # epoch's wall (and its `resize` badput bucket), never into
+            # throughput
+            if epoch_tic is None:
+                epoch_tic = time.time()
+            tic = epoch_tic
+            attempt_tic = time.time()
+            resize_ev = None
             compile_snap = compile_mod.registry().snapshot()
             comm_snap = comm_mod.registry().snapshot() \
                 if comm_spec is not None else None
@@ -1410,6 +1599,16 @@ class FeedForward(BASE_ESTIMATOR):
             feed_src = _timed_feed(feed, tl) if tl is not None else feed
             try:
                 for batch, batch_arrays in feed_src:
+                    if elastic_co is not None:
+                        # membership poll, once per step: chaos sites,
+                        # heartbeat expiry, then any pending change —
+                        # a hit aborts the attempt (this epoch redoes on
+                        # the new world after the resize below)
+                        elastic_co.chaos_poll()
+                        elastic_co.check_heartbeats()
+                        resize_ev = elastic_co.poll()
+                        if resize_ev is not None:
+                            break
                     span = tl.begin_step(epoch, nbatch) if tl is not None \
                         else None
                     if preempt_handler is not None and \
@@ -1592,6 +1791,14 @@ class FeedForward(BASE_ESTIMATOR):
             finally:
                 if feed_depth > 0:
                     feed.close()
+            if resize_ev is not None:
+                # elastic resize: quiesce, re-shard, re-plan, re-warm —
+                # then redo this epoch on the new world. Everything the
+                # aborted attempt spent (its steps get redone) plus the
+                # resize downtime is this epoch's `resize` badput.
+                _apply_resize(resize_ev)
+                resize_badput += time.time() - attempt_tic
+                continue
             if stale_sync:
                 # drain the pipeline at the epoch boundary: the last step's
                 # push must land before callbacks/checkpoints read weights
@@ -1715,6 +1922,7 @@ class FeedForward(BASE_ESTIMATOR):
                                   - retries_base)
                     if guard_cfg is not None else 0,
                     checkpoint_seconds=_ckpt_seconds() - ckpt_base,
+                    resize_seconds=resize_badput,
                     logger=logger)
 
             _write_back()
@@ -1739,11 +1947,16 @@ class FeedForward(BASE_ESTIMATOR):
                     _preempt_flush()  # don't start callbacks on a dead clock
                 for cb in _as_list(epoch_end_callback):
                     cb(epoch, self.symbol, self.arg_params, self.aux_params)
+            epoch_tic = None
+            resize_badput = 0.0
+            epoch += 1
         finally:
             if watchdog is not None:
                 watchdog.stop()
             if preempt_handler is not None:
                 preempt_mod.PreemptionHandler.uninstall()
+            if elastic_co is not None:
+                telemetry_mod.set_world(*elastic_prev_world)
             # a mid-step exception (preemption, retry exhaustion) can leave
             # an un-ended span in the thread-local slot; later phase()
             # calls must not attach to it, and score()/eval after this fit

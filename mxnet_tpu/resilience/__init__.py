@@ -13,6 +13,11 @@ Failure model (what the pieces cover):
                                        checkpoint flush in model.fit
   torn / corrupt checkpoints        -> utils.checkpoint manifest (CRC) +
                                        latest_step skipping invalid steps
+  worker churn (die / rejoin)       -> elastic.ElasticCoordinator: resize
+                                       the world mid-run without a process
+                                       restart (fit(elastic=...); kvstore
+                                       membership epochs promote hangs to
+                                       detected membership changes)
   proving any of it works           -> chaos (seeded fault injection,
                                        tests only)
 """
@@ -20,6 +25,9 @@ Failure model (what the pieces cover):
 from .chaos import (Chaos, ChaosConfig, TransientError, TransientStepError,
                     chaos_scope)
 from . import chaos
+from . import elastic
+from .elastic import (ElasticCoordinator, MembershipChanged,
+                      MembershipTimeout, ResizeEvent)
 from .guards import GuardConfig, StepTimeoutError, StepWatchdog
 from .preempt import PreemptionHandler, TrainingPreempted
 from .retry import CircuitBreaker, CircuitOpenError, RetryingKVStore, \
@@ -27,6 +35,8 @@ from .retry import CircuitBreaker, CircuitOpenError, RetryingKVStore, \
 
 __all__ = ["chaos", "Chaos", "ChaosConfig", "chaos_scope",
            "TransientError", "TransientStepError",
+           "elastic", "ElasticCoordinator", "MembershipChanged",
+           "MembershipTimeout", "ResizeEvent",
            "GuardConfig", "StepTimeoutError", "StepWatchdog",
            "PreemptionHandler", "TrainingPreempted",
            "CircuitBreaker", "CircuitOpenError", "RetryingKVStore",

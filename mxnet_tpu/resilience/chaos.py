@@ -29,6 +29,12 @@ Sites currently wired through the stack:
                                         dispatching the train step
   ``step.hang``                         fit: simulate a hung step (host
                                         sleep until the watchdog trips)
+  ``elastic.kill``                      elastic fit: the coordinator kills
+                                        the highest alive virtual worker
+                                        (ElasticCoordinator.chaos_poll,
+                                        one occurrence per step)
+  ``elastic.rejoin``                    elastic fit: every departed worker
+                                        rejoins (capacity returned)
 
 Triggers are either a probability in [0, 1) — each query of the site draws
 from a per-site ``random.Random`` seeded by ``(seed, site)`` — or an

@@ -420,10 +420,22 @@ def detect_stragglers(events_by_rank, mad_k=3.5, abs_floor=1e-3,
         {"stragglers": [{rank, blame, flagged_steps, steps,
                          excess_seconds, mean_step_seconds}],
          "skew_seconds": <slowest rank's median step - fleet median>,
-         "ranks": {...per-rank stats...}}
+         "ranks": {...per-rank stats...},
+         "membership": {segments, final_ranks, departed}}
 
     and (``publish=True``) mirrors ``skew_seconds`` plus per-rank
     ``straggler_excess_seconds`` gauges back through the hub.
+
+    Elastic runs (ISSUE 10): the rank set is NOT assumed fixed. The
+    reporting rank set of each step defines a membership *segment*; at a
+    segment boundary (a rank departed or rejoined — per-device step time
+    legitimately changes when the world resizes) the rolling envelope
+    resets so old-world durations never judge new-world steps, and only
+    ranks still reporting near the run's end can be flagged as
+    stragglers — departed ranks are reported under
+    ``membership.departed`` instead of being blamed for steps they were
+    dead for. (``skew_seconds`` keeps its historical all-ranks
+    definition so fixed-fleet baselines stay comparable.)
     """
     # (step key -> {rank: {phase: dur}}) over step spans only
     table = {}
@@ -442,10 +454,45 @@ def detect_stragglers(events_by_rank, mad_k=3.5, abs_floor=1e-3,
     excess = {r: {} for r in events_by_rank}     # rank -> phase -> seconds
     breaches = {r: {} for r in events_by_rank}   # rank -> phase -> #steps
     recent = []                                   # rolling envelope window
-    for key in sorted(table):
+    ordered = sorted(table)
+    # membership: a rank is DEPARTED when it stopped reporting well before
+    # the run's end (position-based, so a one-step gap from thread racing
+    # never buries a live rank); segment commits likewise need TWO
+    # consecutive steps with the same new rank set before the envelope
+    # resets — transient per-step flicker is not a resize
+    last_seen = {}
+    for i, key in enumerate(ordered):
+        for r in table[key]:
+            last_seen[r] = i
+    tail = max(2, min(window, len(ordered)) // 4)
+    final_ranks = {r for r, i in last_seen.items()
+                   if i >= len(ordered) - tail}
+    if not final_ranks:
+        final_ranks = set(events_by_rank)
+    segments = 0
+    cur_members = None
+    pending = None                                # (candidate set, streak)
+    for key in ordered:
         per_rank = table[key]
         if len(per_rank) < 2:
             continue
+        ranks_here = frozenset(per_rank)
+        if cur_members is None:
+            cur_members = ranks_here
+            segments = 1
+        elif ranks_here != cur_members:
+            pending = (ranks_here, pending[1] + 1) \
+                if pending and pending[0] == ranks_here else (ranks_here, 1)
+            if pending[1] >= 2:
+                # committed membership change: resized worlds have
+                # different per-device step times, so the envelope must
+                # not carry over
+                cur_members = ranks_here
+                pending = None
+                segments += 1
+                recent.clear()
+        else:
+            pending = None
         recent.append(per_rank)
         if len(recent) > window:
             recent.pop(0)
@@ -481,10 +528,13 @@ def detect_stragglers(events_by_rank, mad_k=3.5, abs_floor=1e-3,
     fleet_median = _median(list(medians.values())) if medians else 0.0
     skew = max((m - fleet_median for m in medians.values()), default=0.0)
 
+    departed = sorted(r for r in events_by_rank if r not in final_ranks)
     stragglers = []
     for rank in sorted(events_by_rank):
         if not comparable[rank]:
             continue
+        if rank not in final_ranks:
+            continue  # departed: listed under membership, never blamed
         frac = flagged[rank] / comparable[rank]
         if frac >= min_flagged_frac and excess[rank]:
             # blame the CONSISTENTLY breaching phase (most steps outside
@@ -507,10 +557,14 @@ def detect_stragglers(events_by_rank, mad_k=3.5, abs_floor=1e-3,
     report = {
         "stragglers": stragglers,
         "skew_seconds": round(skew, 6),
-        "ranks": {r: {"median_step_seconds": round(medians.get(r, 0.0), 6),
+        "ranks": {r: {"median_step_seconds": round(
+                          _median(step_dur.get(r, [])), 6),
                       "flagged_steps": flagged[r],
                       "comparable_steps": comparable[r]}
                   for r in sorted(events_by_rank)},
+        "membership": {"segments": segments,
+                       "final_ranks": sorted(final_ranks),
+                       "departed": departed},
     }
     if publish:
         h = _hub()

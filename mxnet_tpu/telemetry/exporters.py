@@ -48,6 +48,8 @@ EVENT_GOLDEN_KEYS = {
     "flight_dump": ("reason", "path"),
     "watchdog": ("deadline",),
     "chaos": ("site",),
+    # elastic training (ISSUE 10)
+    "resize": ("from_world", "to_world", "reason", "membership_epoch"),
     # memory observability (ISSUE 9)
     "memory_plan": ("program", "argument_bytes", "output_bytes",
                     "temp_bytes", "total_bytes"),

@@ -58,7 +58,11 @@ def record_compile_badput(total_seconds, window_seconds, epoch=None):
     cumulative counter; idempotent across overlapping observers. Returns
     the newly-counted seconds."""
     with _COMPILE_WM_LOCK:
-        if _COMPILE_WM[0] is None:
+        if _COMPILE_WM[0] is None or total_seconds < _COMPILE_WM[0]:
+            # first observation — or the cumulative counter went BACKWARD,
+            # which means the compile registry was reset
+            # (utils.compile.reset_compile_stats): re-baseline instead of
+            # letting the stale high-water mark eat every future window
             _COMPILE_WM[0] = total_seconds - window_seconds
         start = max(_COMPILE_WM[0], total_seconds - window_seconds)
         delta = total_seconds - start
@@ -137,6 +141,18 @@ class MFUAccountant:
             self._peak = resolve_peak_flops(self.num_devices)
         return self._peak
 
+    def set_num_devices(self, num_devices):
+        """Elastic resize: the world changed size mid-run. The aggregate
+        peak re-resolves for the new device count; FLOPs/step stay — the
+        fused step computes the same GLOBAL batch regardless of how many
+        devices the dp axis splits it over, so the model-FLOPs numerator
+        is resize-invariant."""
+        num_devices = max(int(num_devices), 1)
+        if num_devices != self.num_devices:
+            self.num_devices = num_devices
+            self._peak = None
+        return self.num_devices
+
     # -- FLOP resolution ------------------------------------------------------
     def maybe_trace(self, jitted, args):
         """Resolve FLOPs/step from the program about to dispatch (no-op
@@ -174,11 +190,13 @@ class MFUAccountant:
     # -- epoch reporting ------------------------------------------------------
     def epoch_report(self, epoch, steps, wall_seconds, *, compile_seconds=0.0,
                     data_wait_seconds=0.0, skipped_steps=0, step_retries=0,
-                    checkpoint_seconds=0.0, logger=None):
+                    checkpoint_seconds=0.0, resize_seconds=0.0, logger=None):
         """Compute + log + export the epoch's MFU and goodput lines.
 
         Badput buckets (non-overlapping slices of ``wall_seconds``):
-        compile (XLA), data stalls, checkpoint flushes, and wasted steps —
+        compile (XLA), data stalls, checkpoint flushes, elastic resizes
+        (quiesce + reshard + replan + rewarm downtime plus the aborted
+        partial attempt the resize threw away), and wasted steps —
         retried dispatches plus non-finite skipped steps, each costed at
         the epoch's mean step time. Returns the report dict."""
         logger = logger or logging
@@ -191,6 +209,7 @@ class MFUAccountant:
             "compile": min(float(compile_seconds), wall),
             "data_wait": min(float(data_wait_seconds), wall),
             "checkpoint": min(float(checkpoint_seconds), wall),
+            "resize": min(float(resize_seconds), wall),
             "wasted_steps": min(wasted_steps * mean_step, wall),
         }
         bad_total = min(sum(badput.values()), wall)
@@ -230,9 +249,10 @@ class MFUAccountant:
                 h.emit("badput", reason=reason, seconds=seconds, epoch=epoch)
         logger.info(
             "Epoch[%d] Goodput: %.1f%% (badput: compile %.2fs, data-wait "
-            "%.2fs, checkpoint %.2fs, wasted steps %d ≈ %.2fs)", epoch,
-            goodput, badput["compile"], badput["data_wait"],
-            badput["checkpoint"], wasted_steps, badput["wasted_steps"])
+            "%.2fs, checkpoint %.2fs, resize %.2fs, wasted steps %d ≈ "
+            "%.2fs)", epoch, goodput, badput["compile"],
+            badput["data_wait"], badput["checkpoint"], badput["resize"],
+            wasted_steps, badput["wasted_steps"])
         h.emit("epoch_summary", **{k: v for k, v in report.items()
                                    if k != "badput"}, **{
             f"badput_{k}_seconds": v for k, v in badput.items()})

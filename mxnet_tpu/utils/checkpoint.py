@@ -37,7 +37,8 @@ import zlib
 import jax
 import numpy as np
 
-__all__ = ["save_sharded", "load_sharded", "latest_step", "validate_step"]
+__all__ = ["save_sharded", "load_sharded", "load_resharded", "latest_step",
+           "validate_step"]
 
 _STATE_DIR = "state"
 _SYMBOL_FILE = "symbol.json"
@@ -316,3 +317,29 @@ def load_sharded(directory, step=None, shardings=None, with_comm=False):
     if with_comm:
         return params, aux, symbol, meta, opt_leaves, comm_state
     return params, aux, symbol, meta, opt_leaves
+
+
+def load_resharded(directory, mesh, step=None):
+    """Reshard-on-load (ISSUE 10): restore a checkpoint and place
+    params/aux straight onto ``mesh`` — replicated, the data-parallel
+    contract (every device holds the full weights; the batch is what
+    shards) — regardless of what topology saved it. The elastic resize
+    path uses this to land CRC-validated state onto the NEW axis size.
+
+    Returns ``(params, aux, symbol, meta, opt_leaves, comm_state)``:
+    ``opt_leaves`` come back host-side for the caller to re-thread
+    through its optimizer treedef (they replicate on first dispatch), and
+    ``comm_state`` (error-feedback residuals) comes back host-side for
+    layout-key validation — residuals are ``(old_axis, Lp)`` rows and are
+    only meaningful if the bucket layout still matches
+    (``comm.residuals_match_plan`` + the ``comm_layout`` metadata key);
+    a changed axis size changes the layout key and drops them."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params, aux, symbol, meta, opt_leaves, comm_state = load_sharded(
+        directory, step, with_comm=True)
+    repl = NamedSharding(mesh, P())
+    params = {k: jax.device_put(np.asarray(v), repl)
+              for k, v in params.items()}
+    aux = {k: jax.device_put(np.asarray(v), repl) for k, v in aux.items()}
+    return params, aux, symbol, meta, opt_leaves, comm_state

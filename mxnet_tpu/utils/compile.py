@@ -503,6 +503,13 @@ class TrackedJit:
     def aot_programs(self):
         return len(self._aot)
 
+    def is_warm(self, *args, **kwargs) -> bool:
+        """Is an AOT executable already registered for this argument
+        signature? The elastic resize path asks this before re-warming:
+        growing back to a previously-seen axis size finds the old world's
+        programs still warm and skips the lower+compile entirely."""
+        return self.signature(args, kwargs) in self._aot
+
 
 def tracked_jit(fn, label=None, **jit_kwargs) -> TrackedJit:
     """Drop-in ``jax.jit`` replacement that reports to the program registry

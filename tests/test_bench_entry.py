@@ -160,6 +160,38 @@ def test_bench_overlap_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_OVERLAP_*
 
 
+def test_bench_elastic_smoke_json_contract():
+    """--elastic-bench --smoke is the CI guard on the elastic-training
+    bench entry (ISSUE 10): one JSON line with the contract keys, both
+    resizes (8->6 shrink, 6->8 regrow) executed with measured downtime,
+    per-world step times, and the resize badput priced into goodput."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--elastic-bench", "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "shrink_downtime_s", "grow_downtime_s", "resizes",
+                "worlds", "step_ms_by_world", "goodput_pct_by_epoch",
+                "resize_badput_s"):
+        assert key in blob, blob
+    assert blob["metric"] == "elastic_resize_downtime_seconds"
+    # both resizes happened and were priced
+    assert blob["resizes"] == 2
+    assert blob["worlds"] == [6, 8]
+    assert blob["shrink_downtime_s"] > 0
+    assert blob["grow_downtime_s"] > 0
+    assert blob["resize_badput_s"] > 0
+    # training ran at every world size
+    for world in ("8_pre", "6", "8_post"):
+        assert blob["step_ms_by_world"].get(world, 0) > 0, blob
+    assert blob["smoke"] is True  # smoke runs never write BENCH_ELASTIC_*
+
+
 @pytest.mark.slow
 def test_bench_pipeline_mode_json_contract(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")

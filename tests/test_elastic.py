@@ -1,0 +1,605 @@
+"""Elastic training tests (ISSUE 10).
+
+Covers: the ElasticCoordinator control plane (kill/leave/join coalescing,
+min_world, heartbeat expiry, chaos wiring), kvstore membership epochs —
+the BSP group server releasing open accumulate/barrier rounds on
+deregistration and promoting stalls to MembershipTimeout, the async
+parameter host's leave/join ops + bounded barrier rounds —, checkpoint
+re-shard round-trips across axis sizes (8->6->8) with layout-key
+invalidation of EF residuals, resize-aware MFU/goodput + straggler
+accounting, and the chaos-harness acceptance scenario: kill 2 of 8
+virtual workers mid-epoch -> continue on 6 -> rejoin to 8, with the
+resumed trajectory bitwise-equal to a checkpoint-replay reference, the
+downtime priced as `resize` badput, and coordinator spans in the merged
+trace.
+"""
+
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import comm
+from mxnet_tpu import kvstore as kvstore_mod
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resilience import (ElasticCoordinator, MembershipTimeout,
+                                  chaos_scope)
+from mxnet_tpu.utils import checkpoint as ckpt_mod
+
+
+@pytest.fixture(autouse=True)
+def _restore_world_identity():
+    """ElasticCoordinator.commit relabels the process (rank, world) —
+    intended during a run, but tests calling commit() directly must not
+    leak this run's world into later tests' metric labels."""
+    prev = (telemetry.current_rank(), telemetry.world_size())
+    yield
+    telemetry.set_world(*prev)
+
+
+def _ctx(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+    return [mx.cpu(i) for i in range(n)]
+
+
+def _mlp(hidden=16, classes=2):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=hidden)
+    net = mx.sym.Activation(data=net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _blobs(n=480, dim=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.concatenate([rng.randn(n // 2, dim) + 1,
+                        rng.randn(n - n // 2, dim) - 1]).astype(np.float32)
+    y = np.concatenate([np.zeros(n // 2), np.ones(n - n // 2)]).astype(
+        np.float32)
+    order = rng.permutation(n)
+    return X[order], y[order]
+
+
+# -- coordinator control plane -------------------------------------------------
+
+def test_coordinator_membership_lifecycle():
+    co = ElasticCoordinator(8)
+    assert co.world_size == 8 and co.poll() is None
+    assert co.kill() == 7          # default victim: highest alive rank
+    assert co.kill(5) == 5
+    ev = co.poll()
+    assert ev.kind == "shrink" and ev.world_size == 6
+    assert ev.ranks == (0, 1, 2, 3, 4, 6)   # coalesced: ONE resize
+    assert co.world_size == 8               # nothing committed yet
+    co.commit(ev)
+    assert co.world_size == 6 and co.membership_epoch == 1
+    assert co.poll() is None
+    # idempotent: re-killing a dead rank is silent
+    assert co.kill(7) is None
+    # rejoin: lowest departed first, join_all readmits everyone
+    assert co.join() == 5
+    assert co.join_all() == [7]
+    ev = co.poll()
+    assert ev.kind == "grow" and ev.ranks == tuple(range(8))
+    co.commit(ev)
+    assert co.world_size == 8 and co.resizes == 2
+    assert [h["to"] for h in co.history] == [6, 8]
+
+
+def test_coordinator_min_world_and_request_world():
+    co = ElasticCoordinator(4, min_world=2)
+    co.request_world(2)
+    assert co.poll().ranks == (0, 1)
+    with pytest.raises(MXNetError):
+        co.request_world(1)
+    co.commit(co.poll())
+    with pytest.raises(MXNetError):
+        co.kill(1)
+    co.request_world(4)
+    assert co.poll().kind == "grow"
+
+
+def test_coordinator_heartbeat_expiry():
+    co = ElasticCoordinator(4, heartbeat_timeout=0.05)
+    co.heartbeat(0)
+    co.heartbeat(3)
+    assert co.check_heartbeats() == []      # both fresh
+    time.sleep(0.08)
+    co.heartbeat(0)                         # rank 0 keeps beating
+    assert co.check_heartbeats() == [3]     # silence -> declared dead
+    # ranks that never beat (1, 2) are not judged
+    assert co.poll().ranks == (0, 1, 2)
+
+    # a mass heartbeat lapse HOLDS the min_world floor instead of
+    # crashing the loop that polls it
+    co2 = ElasticCoordinator(2, heartbeat_timeout=0.01)
+    co2.heartbeat(0)
+    co2.heartbeat(1)
+    time.sleep(0.03)
+    assert co2.check_heartbeats() == []     # both expired, both held
+    assert co2.poll() is None
+
+
+def test_coordinator_chaos_sites():
+    co = ElasticCoordinator(4)
+    with chaos_scope(seed=0, rules={"elastic.kill": {1, 2},
+                                    "elastic.rejoin": {4}}):
+        for _ in range(4):
+            co.chaos_poll()
+        assert co.poll().ranks == (0, 1)    # occurrences 1 and 2 killed
+        co.commit(co.poll())
+        co.chaos_poll()                     # occurrence 4 rejoins all
+        assert co.poll().ranks == (0, 1, 2, 3)
+
+
+def test_coordinator_resolve():
+    co = ElasticCoordinator(4)
+    assert ElasticCoordinator.resolve(co, 8) is co
+    assert ElasticCoordinator.resolve(None, 8) is None
+    assert ElasticCoordinator.resolve(False, 8) is None
+    assert ElasticCoordinator.resolve(True, 8).full_world_size == 8
+    with pytest.raises(MXNetError):
+        ElasticCoordinator.resolve("nope", 8)
+
+
+# -- kvstore membership epochs (satellite: no more barrier/push hangs) ---------
+
+def test_group_barrier_released_by_deregistration():
+    """A worker dies mid-barrier-round: deregistration re-evaluates the
+    round against the shrunk world and releases the survivor — the hang
+    becomes a resize, not a stall."""
+    workers = kvstore_mod.create_group(2, op_timeout=10.0)
+    server = workers[0]._server
+    done = []
+    t = threading.Thread(target=lambda: (workers[0].barrier(),
+                                         done.append(True)))
+    t.start()
+    time.sleep(0.05)
+    assert not done                       # blocked on the absent worker 1
+    epoch = server.deregister_worker(1)
+    t.join(timeout=5.0)
+    assert done and epoch == 1 and server.num_workers == 1
+
+
+def test_group_barrier_timeout_promotes_to_membership_change():
+    workers = kvstore_mod.create_group(2, op_timeout=0.15)
+    with pytest.raises(MembershipTimeout) as ei:
+        workers[0].barrier()
+    assert "membership epoch 0" in str(ei.value)
+    # the timed-out arrival was withdrawn: after the dead worker is
+    # deregistered, a retry completes alone instead of double-counting
+    workers[0]._server.deregister_worker(1)
+    workers[0].barrier()
+
+
+def test_group_push_round_released_by_deregistration():
+    workers = kvstore_mod.create_group(3, op_timeout=10.0)
+    server = workers[0]._server
+    server.init("w", np.zeros((4,), np.float32))
+    results = []
+
+    def pusher(rank):
+        workers[rank].push("w", mx.nd.array(np.full((4,), rank + 1.0,
+                                                    np.float32)))
+        results.append(rank)
+
+    threads = [threading.Thread(target=pusher, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    assert not results                    # round open, waiting on worker 2
+    server.deregister_worker(2)
+    for t in threads:
+        t.join(timeout=5.0)
+    assert sorted(results) == [0, 1]
+    # the two arrived contributions were accumulated and applied
+    np.testing.assert_allclose(server.store["w"], np.full((4,), 3.0))
+
+
+def test_group_push_timeout_raises_membership_timeout():
+    workers = kvstore_mod.create_group(2, op_timeout=0.15)
+    server = workers[0]._server
+    server.init("w", np.zeros((2,), np.float32))
+    with pytest.raises(MembershipTimeout):
+        workers[0].push("w", mx.nd.array(np.ones((2,), np.float32)))
+
+
+def test_group_rejoin_handshake():
+    """register_worker: the readmitted worker contributes to the next
+    round and the world is whole again."""
+    workers = kvstore_mod.create_group(2, op_timeout=10.0)
+    server = workers[0]._server
+    server.init("w", np.zeros((2,), np.float32))
+    server.deregister_worker(1)
+    workers[0].push("w", mx.nd.array(np.ones((2,), np.float32)))  # solo
+    np.testing.assert_allclose(server.store["w"], np.ones((2,)))
+    assert server.register_worker(1) == 2  # epochs: leave=1, join=2
+    assert server.num_workers == 2
+    threads = [threading.Thread(
+        target=lambda r=r: workers[r].push(
+            "w", mx.nd.array(np.ones((2,), np.float32))))
+        for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5.0)
+    np.testing.assert_allclose(server.store["w"], np.full((2,), 2.0))
+
+
+def test_async_server_membership_ops(monkeypatch):
+    """The dist_async parameter host: barrier rounds are bounded and
+    membership-tagged; leave/join resize the expected world over the
+    wire (the rejoin reply carries the key set to pull)."""
+    monkeypatch.setenv("MXNET_TPU_KV_OP_TIMEOUT", "0.3")
+    from mxnet_tpu.kvstore_async import (_MAGIC, _AsyncServer, _recv_exact,
+                                         _recv_msg, _send_msg)
+    import socket
+
+    srv = _AsyncServer("127.0.0.1", 0, 2)
+    port = srv._srv.getsockname()[1]
+
+    def connect():
+        s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        s.sendall(_MAGIC)
+        assert _recv_exact(s, 4) == _MAGIC
+        return s
+
+    def call(s, *msg):
+        _send_msg(s, msg)
+        return _recv_msg(s)
+
+    c = connect()
+    try:
+        # lone barrier in a 2-world: the server bounds the round and
+        # answers with a membership error instead of hanging the socket
+        reply = call(c, "barrier")
+        assert reply[0] == "err" and "membership" in reply[1]
+        # the dead worker leaves: world shrinks, epoch bumps
+        reply = call(c, "leave", 1)
+        assert reply[1]["num_workers"] == 1
+        assert reply[1]["membership_epoch"] == 1
+        # a SECOND survivor reporting the same death is a set no-op:
+        # the world shrinks once, not per reporter
+        reply = call(c, "leave", 1)
+        assert reply[1]["num_workers"] == 1
+        assert reply[1]["membership_epoch"] == 1
+        # barrier now completes alone (the timed-out arrival was
+        # withdrawn, so this is exactly one arrival in a 1-world)
+        assert call(c, "barrier")[0] == "ok"
+        call(c, "init", "w", np.zeros((2,), np.float32))
+        # rejoin handshake: world grows back, reply lists keys to pull
+        reply = call(c, "join", 1)
+        assert reply[1]["num_workers"] == 2
+        assert reply[1]["membership_epoch"] == 2
+        assert reply[1]["keys"] == ["w"]
+        stats = call(c, "stats")[1]
+        assert stats["membership_epoch"] == 2
+        assert stats["num_workers"] == 2
+    finally:
+        c.close()
+        srv._srv.close()
+
+
+# -- checkpoint re-shard round trip (satellite: 8 -> 6 -> 8) -------------------
+
+def test_checkpoint_reshard_roundtrip_8_6_8(tmp_path):
+    """Optimizer state and (ndev, Lp) EF residuals round-trip a world
+    resize through the CRC-manifest checkpoint: opt leaves re-thread
+    bitwise on every axis size, residual ledgers survive ONLY when the
+    layout key still matches — 8->6 and 6->8 both invalidate, 8->8
+    preserves."""
+    from mxnet_tpu import parallel as par
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    spec = comm.CompressionSpec.resolve("int8")
+    shapes = {"fc1_weight": (16, 10), "fc1_bias": (16,),
+              "fc2_weight": (2, 16), "fc2_bias": (2,)}
+    rng = np.random.RandomState(3)
+    params = {k: jnp.asarray(rng.randn(*s).astype(np.float32))
+              for k, s in shapes.items()}
+    opt_leaves = [np.asarray(rng.randn(*s).astype(np.float32))
+                  for s in shapes.values()]
+
+    def save(directory, plan, resid):
+        ckpt_mod.save_sharded(
+            directory, 0, params, opt_state=list(opt_leaves),
+            comm_state=resid, extra_meta={"comm_layout": plan.layout_key()})
+
+    def residuals_for(plan, fill):
+        return {b["name"]: np.full((plan.axis_size, b["padded"]), fill,
+                                   np.float32)
+                for b in plan.buckets}
+
+    plan8 = comm.plan_overlap(shapes, spec, 8, max_bytes=256)
+    assert plan8.num_buckets > 1
+    d8 = str(tmp_path / "w8")
+    save(d8, plan8, residuals_for(plan8, 0.25))
+
+    # 8 -> 6: params/opt reshard bitwise onto the 6-mesh; residuals are
+    # laid out for 8 rows and MUST be dropped (layout key differs)
+    mesh6 = par.make_mesh(dp=6, devices=jax.devices()[:6])
+    p6, _aux, _sym, meta, leaves6, comm6 = ckpt_mod.load_resharded(d8, mesh6)
+    plan6 = plan8.replan(6)
+    assert plan6.layout_key() != plan8.layout_key()
+    assert meta["comm_layout"] == plan8.layout_key()
+    assert not comm.residuals_match_plan(comm6, plan6)
+    for k in shapes:
+        assert p6[k].sharding.is_equivalent_to(
+            NamedSharding(mesh6, P()), p6[k].ndim)
+        np.testing.assert_array_equal(np.asarray(p6[k]),
+                                      np.asarray(params[k]))
+    for got, want in zip(leaves6, opt_leaves):
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    # 6 -> 8: save the 6-world state, grow back — residuals for 6 are
+    # dropped again, but an 8-world ledger saved under the 8-layout key
+    # is preserved bit-for-bit on a same-axis resume
+    d6 = str(tmp_path / "w6")
+    save(d6, plan6, residuals_for(plan6, 0.5))
+    mesh8 = par.make_mesh(dp=8, devices=jax.devices()[:8])
+    _p8, _a, _s, meta6, leaves8, comm8 = ckpt_mod.load_resharded(d6, mesh8)
+    assert meta6["comm_layout"] == plan6.layout_key() != plan8.layout_key()
+    assert not comm.residuals_match_plan(comm8, plan8)
+    for got, want in zip(leaves8, opt_leaves):
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    # same-axis reload: layout key matches, the ledger survives exactly
+    _p, _a, _s, meta8, _l, comm_same = ckpt_mod.load_resharded(d8, mesh8)
+    assert meta8["comm_layout"] == plan8.layout_key()
+    assert comm.residuals_match_plan(comm_same, plan8)
+    for b in plan8.buckets:
+        np.testing.assert_array_equal(
+            comm_same[b["name"]],
+            np.full((8, b["padded"]), 0.25, np.float32))
+
+
+# -- telemetry: resize-aware accounting ----------------------------------------
+
+def test_mfu_accountant_resize():
+    acct = telemetry.MFUAccountant(num_devices=8, peak_flops=8e9)
+    assert acct.peak_flops == 8e9
+    acct.set_num_devices(6)
+    assert acct.num_devices == 6
+    # peak re-resolves for the new world instead of quoting the dead one
+    assert acct.peak_flops != 8e9
+    report = acct.epoch_report(0, steps=10, wall_seconds=10.0,
+                               resize_seconds=2.5)
+    assert report["badput"]["resize"] == 2.5
+    assert report["goodput_pct"] == pytest.approx(75.0)
+
+
+def test_detect_stragglers_membership_change():
+    """A departed rank is reported under membership, not blamed as a
+    straggler; the envelope resets at the resize boundary so the
+    shrunk world's (slower per-device) steps don't flag survivors."""
+
+    def span(rank, step, device_s):
+        return {"kind": "span", "name": "step", "epoch": 0, "step": step,
+                "dur_ms": device_s * 1e3,
+                "phases": [{"name": "device", "dur_ms": device_s * 1e3}]}
+
+    events = {r: [] for r in range(4)}
+    # segment 1: 4 ranks, rank 3 slow (it is about to die)
+    for step in range(8):
+        for r in range(4):
+            events[r].append(span(r, step, 0.3 if r == 3 else 0.1))
+    # segment 2: rank 3 is gone; survivors uniformly slower (3-world)
+    for step in range(8, 20):
+        for r in range(3):
+            events[r].append(span(r, step, 0.2))
+    report = telemetry.detect_stragglers(events, publish=False)
+    assert report["membership"]["departed"] == [3]
+    assert report["membership"]["final_ranks"] == [0, 1, 2]
+    assert report["membership"]["segments"] == 2
+    assert all(s["rank"] != 3 for s in report["stragglers"])
+    # the uniformly-slower post-resize world flags nobody
+    assert report["stragglers"] == []
+
+
+# -- fit integration -----------------------------------------------------------
+
+def test_elastic_fit_validations(tmp_path):
+    X, y = _blobs(n=96)
+    m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=1, optimizer="sgd",
+                       learning_rate=0.1)
+    with pytest.raises(MXNetError, match="sharded_checkpoint_dir"):
+        m.fit(X, y, batch_size=48, elastic=True)
+    m1 = mx.FeedForward(_mlp(), ctx=[mx.cpu(0)], num_epoch=1,
+                        optimizer="sgd", learning_rate=0.1)
+    with pytest.raises(MXNetError, match="multi-device"):
+        m1.fit(X, y, batch_size=48, elastic=True,
+               sharded_checkpoint_dir=str(tmp_path / "c"))
+    with pytest.raises(MXNetError, match="does not match"):
+        m.fit(X, y, batch_size=48, elastic=ElasticCoordinator(4),
+              sharded_checkpoint_dir=str(tmp_path / "c2"))
+
+
+def test_elastic_fit_chaos_kill_site(tmp_path):
+    """Chaos wiring: the elastic.kill site fires once mid-run, the
+    coordinator buries the victim, and training finishes on 7 (batch 56
+    divides both worlds)."""
+    X, y = _blobs(n=448)
+    co = ElasticCoordinator(8)
+    m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=2, optimizer="sgd",
+                       learning_rate=0.1)
+    it = mx.io.NDArrayIter(X, y, batch_size=56, shuffle=False)
+    with chaos_scope(seed=0, rules={"elastic.kill": {11}}):
+        m.fit(it, batch_size=56, elastic=co,
+              sharded_checkpoint_dir=str(tmp_path / "ckpt"))
+    assert co.resizes == 1
+    assert co.world_size == 7
+    assert co.history[0]["reason"].startswith("kill:7:chaos")
+    assert co.history[0]["downtime_s"] > 0
+    assert m.score(X, y=y) > 0.9
+
+
+def test_elastic_resize_indivisible_batch_raises(tmp_path):
+    X, y = _blobs(n=96)
+    co = ElasticCoordinator(8)
+
+    def cb(param):
+        if param.nbatch == 1 and co.world_size == 8:
+            co.kill()  # 8 -> 7, but 48 % 7 != 0
+    m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=2, optimizer="sgd",
+                       learning_rate=0.1)
+    with pytest.raises(MXNetError, match="not divisible"):
+        m.fit(X, y, batch_size=48, elastic=co, batch_end_callback=cb,
+              sharded_checkpoint_dir=str(tmp_path / "ckpt"))
+
+
+# -- the acceptance scenario ---------------------------------------------------
+
+def _copy_steps(src, dst, steps):
+    os.makedirs(dst, exist_ok=True)
+    for step in steps:
+        shutil.copytree(os.path.join(src, str(step)),
+                        os.path.join(dst, str(step)))
+
+
+def _noop_cb(param):
+    pass
+
+
+def test_elastic_acceptance_kill2_continue_rejoin(tmp_path):
+    """ISSUE 10 acceptance: kill 2 of 8 virtual workers mid-epoch ->
+    training continues on 6 with convergence intact -> workers rejoin to
+    8 -> the resumed trajectory is bitwise-equal to the checkpoint-replay
+    reference at matching steps; the downtime shows up in goodput as a
+    `resize` badput bucket and in the merged trace as coordinator spans."""
+    X, y = _blobs(n=480)
+    batch = 48   # divisible by 8 AND 6: the global batch survives resizes
+    d_el = str(tmp_path / "elastic")
+    jsonl = str(tmp_path / "events.jsonl")
+    co = ElasticCoordinator(8)
+
+    def drive(param):
+        if param.epoch == 1 and param.nbatch == 3 and co.world_size == 8:
+            assert co.kill() == 7
+            assert co.kill() == 6
+        if param.epoch == 2 and param.nbatch == 2 and co.world_size == 6:
+            assert co.join_all() == [6, 7]
+
+    m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=4, optimizer="sgd",
+                       learning_rate=0.1)
+    m.fit(mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False),
+          batch_size=batch, elastic=co, sharded_checkpoint_dir=d_el,
+          batch_end_callback=drive, compression="int8", overlap=True,
+          telemetry=telemetry.TelemetryConfig(jsonl=jsonl))
+
+    # the world shrank, regrew, and training converged on the way
+    assert co.resizes == 2
+    assert [h["to"] for h in co.history] == [6, 8]
+    assert co.world_size == 8
+    assert m.score(X, y=y) > 0.95
+    # every epoch boundary checkpointed (0 = the elastic floor ckpt)
+    assert ckpt_mod.latest_step(d_el) == 4
+
+    # -- bitwise checkpoint-replay reference ------------------------------
+    # Segment A: the killed epoch redone on 6. A fresh model resumes the
+    # SAME pre-kill checkpoint on a 6-device world and trains epoch 1
+    # with the same batches: its step-2 checkpoint must equal the elastic
+    # run's bit for bit (params, optimizer leaves, and EF residuals).
+    d_ref6 = str(tmp_path / "ref6")
+    _copy_steps(d_el, d_ref6, (0, 1))
+    ref6 = mx.FeedForward(_mlp(), ctx=_ctx(6), num_epoch=2,
+                          optimizer="sgd", learning_rate=0.1)
+    ref6.fit(mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False),
+             batch_size=batch, sharded_checkpoint_dir=d_ref6,
+             compression="int8", overlap=True,
+             batch_end_callback=_noop_cb)
+    assert ref6.begin_epoch == 1  # it really resumed, not retrained
+
+    # Segment B: the post-rejoin epoch on 8 from the 6-world checkpoint.
+    d_ref8 = str(tmp_path / "ref8")
+    _copy_steps(d_el, d_ref8, (0, 1, 2))
+    ref8 = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=3,
+                          optimizer="sgd", learning_rate=0.1)
+    ref8.fit(mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False),
+             batch_size=batch, sharded_checkpoint_dir=d_ref8,
+             compression="int8", overlap=True,
+             batch_end_callback=_noop_cb)
+    assert ref8.begin_epoch == 2
+
+    for d_ref, step in ((d_ref6, 2), (d_ref8, 3)):
+        el = ckpt_mod.load_sharded(d_el, step, with_comm=True)
+        ref = ckpt_mod.load_sharded(d_ref, step, with_comm=True)
+        for k in el[0]:
+            np.testing.assert_array_equal(el[0][k], ref[0][k],
+                                          err_msg=f"params[{k}]@{step}")
+        for i, (a, b) in enumerate(zip(el[4], ref[4])):
+            np.testing.assert_array_equal(a, b,
+                                          err_msg=f"opt[{i}]@{step}")
+        assert el[3]["num_update"] == ref[3]["num_update"]
+        assert el[3]["comm_layout"] == ref[3]["comm_layout"]
+        assert (el[5] is None) == (ref[5] is None)
+        if el[5] is not None:
+            for name in el[5]:
+                np.testing.assert_array_equal(
+                    el[5][name], ref[5][name],
+                    err_msg=f"residual[{name}]@{step}")
+
+    # -- downtime priced + traced -----------------------------------------
+    events = telemetry.read_events(jsonl)
+    resizes = [e for e in events if e.get("kind") == "resize"]
+    assert [(e["from_world"], e["to_world"]) for e in resizes] == \
+        [(8, 6), (6, 8)]
+    assert all(e["membership_epoch"] in (1, 2) for e in resizes)
+    resize_badput = [e for e in events if e.get("kind") == "badput"
+                     and e.get("reason") == "resize"]
+    assert resize_badput and all(e["seconds"] > 0 for e in resize_badput)
+    # post-resize events carry the resized world label
+    worlds = {e.get("world_size") for e in resizes}
+    assert worlds == {6, 8}
+    # coordinator spans: one per resize, visible in the merged trace
+    rspans = m.telemetry.steps(kind="resize")
+    assert len(rspans) == 2
+    trace, report = telemetry.merge_traces([jsonl])
+    names = {e.get("name", "") for e in trace["traceEvents"]}
+    assert any(n.startswith("resize[") for n in names)
+
+
+def test_elastic_regrow_reuses_warm_programs(tmp_path):
+    """Growing back to a previously-warmed axis size recompiles nothing:
+    the TrackedJit AOT table still holds the old world's executable."""
+    from mxnet_tpu.utils import compile as cm
+
+    X, y = _blobs(n=192)
+    co = ElasticCoordinator(8)
+    events = {"shrunk": False, "grown": False}
+
+    def drive(param):
+        if param.epoch == 1 and param.nbatch == 1 and not events["shrunk"]:
+            events["shrunk"] = True
+            co.kill(), co.kill()
+        if param.epoch == 2 and param.nbatch == 1 and not events["grown"]:
+            events["grown"] = True
+            co.join_all()
+
+    m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=4, optimizer="sgd",
+                       learning_rate=0.1)
+    # warm the 8-world program BEFORE training so the regrow can prove
+    # reuse: precompile is idempotent per signature
+    m.precompile(data_shapes={"data": (48, 10)},
+                 label_shapes={"softmax_label": (48,)},
+                 batch_end_callback=drive)
+    m.fit(mx.io.NDArrayIter(X, y, batch_size=48, shuffle=False),
+          batch_size=48, elastic=co, sharded_checkpoint_dir=str(tmp_path),
+          batch_end_callback=drive)
+    assert co.resizes == 2
+    warm = [fn._tracked for fn in m._train_fns.values()
+            if getattr(fn, "_tracked", None) is not None]
+    # two programs total: one per axis size — NOT three (the regrow found
+    # the warmed 8-world TrackedJit and compiled nothing new)
+    assert len(warm) == 2
+    assert all(tj.aot_programs == 1 for tj in warm)

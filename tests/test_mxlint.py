@@ -472,6 +472,83 @@ def test_tree_has_no_mx309_findings():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+# -- MX310 world-size-literal-in-closure fixtures (ISSUE 10) -------------------
+
+def test_fixture_mx310_world_literal_in_closure():
+    src = (
+        "def build(mesh):\n"
+        "    ndev = 8\n"
+        "    def step(x):\n"
+        "        return x / ndev\n"
+        "    return step\n"
+    )
+    findings = lint_source(src, "fx.py")
+    assert _ids(findings) == ["MX310"]
+    assert findings[0].line == 4  # reported at the stale use
+    # name matching covers the whole world-size vocabulary
+    src2 = src.replace("ndev", "world_size")
+    assert _ids(lint_source(src2, "fx.py")) == ["MX310"]
+
+
+def test_fixture_mx310_healthy_idioms_clean():
+    # derived from the live mesh: a call result, not a frozen literal
+    src = (
+        "def build(mesh):\n"
+        "    ndev = int(mesh.shape['dp'])\n"
+        "    def step(x):\n"
+        "        return x / ndev\n"
+        "    return step\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+    # passed as an argument: every (re)build sees the current world
+    src2 = (
+        "def build():\n"
+        "    ndev = 8\n"
+        "    def step(x, ndev):\n"
+        "        return x / ndev\n"
+        "    return step\n"
+    )
+    assert _ids(lint_source(src2, "fx.py")) == []
+    # rebound inside the closure: not a capture
+    src3 = (
+        "def build():\n"
+        "    ndev = 8\n"
+        "    def step(x):\n"
+        "        ndev = len(x)\n"
+        "        return x / ndev\n"
+        "    return step\n"
+    )
+    assert _ids(lint_source(src3, "fx.py")) == []
+    # a literal used only in the binding scope is fine (no closure)
+    src4 = (
+        "def build():\n"
+        "    ndev = 8\n"
+        "    return list(range(ndev))\n"
+    )
+    assert _ids(lint_source(src4, "fx.py")) == []
+    # the mesh/coordinator providers may define worlds from literals
+    src5 = (
+        "def build():\n"
+        "    ndev = 8\n"
+        "    def step(x):\n"
+        "        return x / ndev\n"
+        "    return step\n"
+    )
+    assert _ids(lint_source(src5, "mxnet_tpu/parallel/mesh.py")) == []
+    assert _ids(lint_source(src5, "mxnet_tpu/resilience/elastic.py")) == []
+
+
+def test_tree_has_no_mx310_findings():
+    """ISSUE 10 satellite: the tree self-lints clean of world-size
+    literals frozen into closures — every axis/world size a closure uses
+    is derived from the live mesh/kvstore/coordinator or passed in."""
+    from mxnet_tpu.analysis import lint_paths
+
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX310"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 # -- MX307 leaked-span fixtures (ISSUE 6 satellite) ----------------------------
 
 def test_fixture_mx307_leaked_span():

@@ -134,6 +134,9 @@ def test_jsonl_schema_golden_keys(tmp_path):
     h.emit("flight_dump", reason="manual", path="/tmp/f.json")
     h.emit("watchdog", deadline=5.0)
     h.emit("chaos", site="kvstore.push")
+    # elastic-training kind (ISSUE 10)
+    h.emit("resize", from_world=8, to_world=6, reason="kill:7:chaos",
+           membership_epoch=1, resize_kind="shrink")
     # memory-observability kinds (ISSUE 9)
     telemetry.memory.publish_plan("train_step:abc", {
         "argument_bytes": 1024, "output_bytes": 128, "temp_bytes": 2048,
