@@ -13,6 +13,15 @@ Three passes over three representations of the same program:
   Pass 3  jaxpr audit    (`jaxpr_audit`) — inspects a bound executor's
           traced jaxpr for host transfers, dtype promotions, and per-op
           FLOP/byte totals (feeds tools/bench_roofline.py).
+  Pass 4  concurrency    (`concurrency`)  — whole-package model of thread
+          entry points and lock scopes: shared-state races (MX701),
+          lock-order cycles (MX702), bare cv.wait (MX703), leaked
+          non-daemon threads (MX704), fresh-lock locking (MX705). The
+          runtime complement is the lock-order watchdog (`lockwatch`,
+          gate MXNET_TPU_LOCKWATCH): the repo's locks are built by its
+          named factory, and enabling it records per-thread held-lock
+          sets plus the global acquisition-order graph, reporting cycles
+          and stalls as hub gauges and flight-recorder incidents.
 
 Rules live in a registry (`rules`) keyed by stable ids (MX101, ...), each
 with a severity and a fixit hint — adding a rule never touches a driver.
@@ -27,13 +36,28 @@ Suppression: ``# mxlint: disable=MX101`` on the offending line, or
 from .rules import RULES, Finding, Rule, get_rule, register_rule
 from .source_lint import lint_file, lint_paths, lint_source
 from .graph import verify_json, verify_json_file, verify_symbol
+from . import lockwatch
 
 __all__ = [
     "RULES", "Finding", "Rule", "get_rule", "register_rule",
     "lint_file", "lint_paths", "lint_source",
     "verify_json", "verify_json_file", "verify_symbol",
     "audit_executor", "audit_jaxpr", "cost_rows", "main",
+    "lockwatch", "concurrency_lint_paths", "concurrency_lint_source",
 ]
+
+
+def concurrency_lint_paths(paths):
+    """Pass 4 over a file set (lazy import keeps the package light)."""
+    from . import concurrency
+
+    return concurrency.lint_paths(paths)
+
+
+def concurrency_lint_source(text, path="<string>"):
+    from . import concurrency
+
+    return concurrency.lint_source(text, path)
 
 
 def audit_executor(*args, **kwargs):

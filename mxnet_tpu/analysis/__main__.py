@@ -25,6 +25,11 @@ def _parser():
     p.add_argument("paths", nargs="*", default=[],
                    help=".py files, directories, or symbol .json files "
                         "(default: the installed mxnet_tpu package tree)")
+    p.add_argument("--concurrency", action="store_true",
+                   help="additionally run the whole-package concurrency "
+                        "pass (MX701-MX705: shared-state races, "
+                        "lock-order cycles, bare cv.wait, leaked "
+                        "threads, fresh-lock locking)")
     p.add_argument("--select", default="",
                    help="comma-separated rule ids to report (default: all)")
     p.add_argument("--ignore", default="",
@@ -61,6 +66,7 @@ def main(argv=None) -> int:
 
     findings = []
     n_files = 0
+    py_paths = []
     for path in paths:
         if path.endswith(".json"):
             from .graph import verify_json_file
@@ -70,7 +76,15 @@ def main(argv=None) -> int:
             continue
         for f in iter_python_files([path]):
             n_files += 1
+            py_paths.append(f)
             findings.extend(lint_file(f))
+    if args.concurrency and py_paths:
+        from . import concurrency
+
+        # Pass 1 already reported MX100 for unparsable files; the
+        # concurrency pass would re-report them
+        findings.extend(f for f in concurrency.lint_paths(py_paths)
+                        if f.rule.id != "MX100")
 
     if select:
         findings = [f for f in findings if f.rule.id in select]

@@ -14,6 +14,10 @@ Rule id bands:
   MX4xx  graph verifier (Symbol.verify: shapes, dtypes, names, dead code)
   MX5xx  jaxpr auditor (host transfers, dtype promotions)
   MX6xx  robustness (bare excepts, unbounded retry loops)
+  MX7xx  concurrency (shared state without a common lock, lock-order
+         cycles, bare cv.wait, leaked non-daemon threads, fresh-lock
+         locking) — analysis/concurrency.py, with the runtime lock-order
+         watchdog (analysis/lockwatch.py) as its dynamic complement
 
 Severities: ``error`` fails the CLI (exit 1) and makes ``Symbol.verify``
 raise; ``warning`` is reported but non-fatal; ``info`` is advisory output.
@@ -270,6 +274,54 @@ register_rule(
     "bare `except:` swallows KeyboardInterrupt/SystemExit and masks the "
     "real failure",
     "catch a concrete exception type (at minimum `except Exception:`)")
+# MX7xx — concurrency (ISSUE 11: the linter finally sees a thread)
+register_rule(
+    "MX701", "warning",
+    "shared mutable state written from two or more thread entry points "
+    "with no common lock: at least two of {thread targets, GC/weakref "
+    "callbacks, signal handlers, hub sinks, server handlers, the main "
+    "thread} mutate the same attribute/global and no single lock covers "
+    "every mutation site — a lost-update/torn-state race",
+    "guard every mutation of the shared attribute with ONE lock (the "
+    "analysis.lockwatch factory gives it a name the runtime watchdog can "
+    "see), or make the state thread-local/queue-passed; if the sharing "
+    "is provably safe (e.g. GIL-atomic flag, single-writer), pragma the "
+    "line with a one-line justification")
+register_rule(
+    "MX702", "warning",
+    "inconsistent lock-acquisition order across functions: the static "
+    "lock graph (who acquires what while holding what, merged over the "
+    "whole linted file set) contains a cycle — two threads interleaving "
+    "the two orders deadlock, and no test that doesn't hit the exact "
+    "interleaving will ever catch it",
+    "pick one global order for the locks in the cycle and acquire in "
+    "that order everywhere (release-then-reacquire if needed); verify "
+    "at runtime with MXNET_TPU_LOCKWATCH=1 (analysis.lockwatch reports "
+    "cycles as flight-recorder incidents)")
+register_rule(
+    "MX703", "warning",
+    "`cv.wait()` without a predicate loop: condition waits wake "
+    "spuriously and on ANY notify, so a bare wait() proceeds on state "
+    "that isn't there yet",
+    "use `cv.wait_for(predicate, timeout=...)` (the repo idiom — see "
+    "kvstore._GroupServer), or re-check the predicate in a while loop "
+    "around the wait")
+register_rule(
+    "MX704", "warning",
+    "non-daemon thread never joined: it outlives every shutdown path, "
+    "keeps the interpreter alive at exit, and its work races teardown "
+    "(module globals become None during finalization)",
+    "pass daemon=True for fire-and-forget service threads, or keep the "
+    "handle and join() it on every shutdown path (close/stop/__exit__)")
+register_rule(
+    "MX705", "warning",
+    "locking a freshly-constructed lock: `with threading.Lock():` (or "
+    "the `with getattr(self, '_lock', threading.Lock()):` fallback "
+    "pattern) creates a new private lock per call — every caller locks "
+    "its own instance and the critical section guards nothing",
+    "construct the lock once (in __init__, via analysis.lockwatch."
+    "named_lock) and reuse that single instance at every site")
+
 register_rule(
     "MX602", "error",
     "unbounded retry loop: `while True` swallowing exceptions with no "

@@ -987,7 +987,15 @@ def _suppressed(finding: Finding, lines: list[str]) -> bool:
         _, _, ids = pragma.partition("=")
         if not ids.strip():
             return True
-        return finding.rule.id in {i.strip() for i in ids.split(",")}
+        # `disable=MX704 - justification` / `disable=MX701,MX704 reason`:
+        # an id token ends at the first whitespace, so an inline
+        # justification (the MX70x audit-record discipline) parses clean
+        tokens = set()
+        for part in ids.split(","):
+            part = part.strip()
+            if part:
+                tokens.add(part.split()[0])
+        return finding.rule.id in tokens
     return False
 
 

@@ -30,8 +30,8 @@ Three complementary sources of truth:
 from __future__ import annotations
 
 import re
-import threading
 
+from ..analysis.lockwatch import named_lock
 from .compression import CompressionSpec, payload_nbytes, quantization_unit
 
 __all__ = ["allreduce_plan", "overlap_plan", "fp32_allreduce_wire_bytes",
@@ -154,11 +154,15 @@ class CommRegistry:
     """Per-program comm plans + per-step wire counters (thread-safe)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # constructed unconditionally BEFORE reset(): the old
+        # `getattr(self, "_lock", threading.Lock())` fallback locked a
+        # fresh private lock when _lock was missing, guarding nothing
+        # (the MX705 bug class — this line is the rule's citation)
+        self._lock = named_lock("comm.CommRegistry")
         self.reset()
 
     def reset(self):
-        with getattr(self, "_lock", threading.Lock()):
+        with self._lock:
             self._plans = {}
             self._steps = {}
             self._extra_bytes = {"sent": 0.0, "received": 0.0}

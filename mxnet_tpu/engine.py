@@ -24,11 +24,11 @@ Engine selection mirrors ``MXNET_ENGINE_TYPE`` (reference src/engine/engine.cc:1
 from __future__ import annotations
 
 import itertools
-import threading
 import time as _time
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutureTimeout
 
+from .analysis.lockwatch import named_lock
 from .base import MXNetError, env_int, env_str
 
 __all__ = ["Engine", "Var", "engine", "naive_engine", "set_engine_type"]
@@ -46,7 +46,6 @@ class Var:
     def __init__(self, name=""):
         self.vid = next(Var._ids)
         self.name = name or f"var{self.vid}"
-        self._lock = threading.Lock()
         self._tail: Future | None = None  # future of the last *write* task
         self._readers: list[Future] = []  # reads since the last write
 
@@ -74,7 +73,7 @@ class Engine:
 
     def __init__(self, num_workers=None, synchronous=False):
         self.synchronous = synchronous
-        self._lock = threading.Lock()
+        self._lock = named_lock("engine.Engine")
         self._inflight: set[Future] = set()
         if synchronous:
             self._pool = None
@@ -181,7 +180,7 @@ class Engine:
     # -- internals ------------------------------------------------------------
     def _chain(self, task, deps):
         remaining = [len(deps)]
-        lock = threading.Lock()
+        lock = named_lock("engine.Engine._chain")
 
         def _dep_done(_f):
             with lock:
@@ -216,7 +215,7 @@ class Engine:
                     pass
 
 
-_engine_lock = threading.Lock()
+_engine_lock = named_lock("engine.global")
 _engines: dict[str, Engine] = {}
 
 

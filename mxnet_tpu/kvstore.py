@@ -54,6 +54,7 @@ import time
 import jax
 import numpy as np
 
+from .analysis.lockwatch import named_condition, named_lock
 from .base import MXNetError
 from .ndarray import NDArray, zeros
 
@@ -405,8 +406,8 @@ class _GroupServer:
             op_timeout = float(raw) if raw else 0.0
         self.op_timeout = op_timeout or None  # 0 -> no deadline
         self.membership_epoch = 0
-        self.lock = threading.Lock()
-        self.cv = threading.Condition(self.lock)
+        self.lock = named_lock("kvstore.GroupServer")
+        self.cv = named_condition("kvstore.GroupServer.cv", self.lock)
         self.store: dict = {}
         self.updater = None
         self._accum: dict = {}

@@ -56,6 +56,7 @@ import time
 
 import numpy as np
 
+from .analysis.lockwatch import named_condition, named_lock
 from .base import MXNetError
 from .kvstore import KVStore, wrap_np_updater
 from .ndarray import NDArray
@@ -181,8 +182,8 @@ class _AsyncServer:
         self.num_workers = num_workers
         self.store: dict = {}
         self.updater = None
-        self.lock = threading.Lock()
-        self.cv = threading.Condition(self.lock)
+        self.lock = named_lock("kvstore_async.AsyncServer")
+        self.cv = named_condition("kvstore_async.AsyncServer.cv", self.lock)
         self._barrier_count = 0
         self._barrier_round = 0
         # elastic membership (ISSUE 10): "leave"/"join" ops resize the
@@ -223,7 +224,9 @@ class _AsyncServer:
                                    # worker publishes, everyone adopts)
         self._conn_tls = threading.local()  # per-connection-thread flags
                                    # (each conn has its own _serve thread)
+        self._serve_seq = 0        # naming: mx-kv-serve-<n> per connection
         self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               name="mx-kv-accept",
                                                daemon=True)
         self._accept_thread.start()
 
@@ -238,7 +241,9 @@ class _AsyncServer:
                 conn.close()
                 continue
             conn.sendall(_MAGIC)
+            self._serve_seq += 1
             threading.Thread(target=self._serve, args=(conn,),
+                             name=f"mx-kv-serve-{self._serve_seq}",
                              daemon=True).start()
 
     def _serve(self, conn):
@@ -625,7 +630,7 @@ class AsyncKVStore(KVStore):
         if self._rank == 0:
             self._server = _AsyncServer(host, port, self._nproc)
         self._sock = self._connect(host, port)
-        self._lock = threading.Lock()
+        self._lock = named_lock("kvstore_async.AsyncKVStore")
         self._next_seq = 0  # identity for at-least-once mutating requests
         self._rpc_timeout = float(
             os.environ.get("MXNET_TPU_RPC_TIMEOUT", "30"))
@@ -903,7 +908,7 @@ class AsyncKVStore(KVStore):
             # ONE background pusher: rounds stay ordered, and the socket
             # lock in _call serializes it against foreground traffic
             self._stale_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="mxtpu-stale-push")
+                max_workers=1, thread_name_prefix="mx-kv-stale-push")
 
         def round_trip():
             t0 = time.perf_counter()

@@ -238,7 +238,7 @@ class _AsyncDeviceFeed:
                         continue
 
         self._thread = threading.Thread(
-            target=worker, daemon=True, name="mxtpu-device-feed")
+            target=worker, daemon=True, name="mx-prefetch")
         self._thread.start()
 
     def close(self):
@@ -254,7 +254,7 @@ class _AsyncDeviceFeed:
         self._thread.join(timeout=5.0)
         if self._thread.is_alive():  # pragma: no cover - hung data_iter.next
             logging.warning(
-                "mxtpu-device-feed worker still running after close() "
+                "mx-prefetch feed worker still running after close() "
                 "(data iterator blocked in next()); resetting the iterator "
                 "now may race the feed thread")
 
@@ -2126,7 +2126,9 @@ class FeedForward(BASE_ESTIMATOR):
 
             workers = min(len(jobs), int(os.environ.get(
                 "MXNET_TPU_PRECOMPILE_THREADS", "4")))
-            with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            with cf.ThreadPoolExecutor(max_workers=workers,
+                                       thread_name_prefix="mx-precompile") \
+                    as pool:
                 futures = [pool.submit(tj.precompile, *args)
                            for tj, args in jobs]
                 for f in futures:

@@ -14,13 +14,14 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 
 import numpy as np
 
+from ..analysis.lockwatch import named_lock
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libmxtpu_predict.so")
-_lock = threading.Lock()
+_lock = named_lock("native.predict.loader")
 _lib = None
 _tried = False
 

@@ -63,6 +63,7 @@ import random
 import threading
 import time
 
+from ..analysis.lockwatch import named_lock
 from ..base import MXNetError
 
 __all__ = ["TransientError", "TransientStepError", "ChaosConfig", "Chaos",
@@ -131,7 +132,7 @@ class Chaos:
 
     def __init__(self, config: ChaosConfig):
         self.config = config
-        self._lock = threading.Lock()
+        self._lock = named_lock("chaos.Chaos")
         self._counts: dict = {}
         self._rngs: dict = {}
         self.fired: dict = {}  # site -> number of injected faults

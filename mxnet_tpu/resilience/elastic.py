@@ -56,6 +56,7 @@ import os
 import threading
 import time
 
+from ..analysis.lockwatch import named_lock
 from ..base import MXNetError
 
 __all__ = ["MembershipChanged", "MembershipTimeout", "ResizeEvent",
@@ -137,7 +138,7 @@ class ElasticCoordinator:
                 f"min_world must be in [1, {world_size}], got "
                 f"{self.min_world}")
         self.heartbeat_timeout = heartbeat_timeout
-        self._lock = threading.Lock()
+        self._lock = named_lock("elastic.ElasticCoordinator")
         self._all = tuple(range(world_size))
         self._alive = set(self._all)
         self._target = set(self._all)
@@ -145,6 +146,8 @@ class ElasticCoordinator:
         self._beats: dict = {}
         self.membership_epoch = 0
         self.resizes = 0
+        self._hb_thread = None
+        self._hb_stop = threading.Event()
         # committed resize records: {"from", "to", "ranks", "reason",
         # "membership_epoch", "downtime_s"} — bench.py --elastic-bench and
         # the acceptance tests read these
@@ -314,6 +317,38 @@ class ElasticCoordinator:
                 "its min_world=%d floor — holding it (beat or raise the "
                 "floor policy to change this)", rank, self.min_world)
         return killed
+
+    def start_heartbeat_monitor(self, interval=None):
+        """Background death-by-silence detection: a daemon thread (named
+        ``mx-heartbeat`` so lockwatch reports and faulthandler tracebacks
+        attribute it by role) runs :meth:`check_heartbeats` every
+        ``interval`` seconds (default: half the heartbeat timeout), so
+        expiry is detected even while the fit loop is stalled inside a
+        long step or a collective. No-op without a ``heartbeat_timeout``;
+        idempotent. Returns the thread (or None)."""
+        if not self.heartbeat_timeout:
+            return None
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return self._hb_thread
+        if interval is None:
+            interval = max(self.heartbeat_timeout / 2.0, 0.01)
+        self._hb_stop.clear()
+
+        def monitor():
+            while not self._hb_stop.wait(interval):
+                self.check_heartbeats()
+
+        self._hb_thread = threading.Thread(target=monitor, daemon=True,
+                                           name="mx-heartbeat")
+        self._hb_thread.start()
+        return self._hb_thread
+
+    def stop_heartbeat_monitor(self):
+        """Stop the background monitor (joined; safe to call twice)."""
+        self._hb_stop.set()
+        t, self._hb_thread = self._hb_thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
 
     # -- chaos wiring ----------------------------------------------------------
     def chaos_poll(self):

@@ -45,6 +45,7 @@ import threading
 
 import jax.numpy as jnp
 
+from ..analysis.lockwatch import named_lock
 from ..base import MXNetError, env_bool
 
 __all__ = ["GuardConfig", "StepTimeoutError", "StepWatchdog",
@@ -150,7 +151,7 @@ class StepWatchdog:
         self.expired = False
         self._abort = env_bool("MXNET_TPU_WATCHDOG_ABORT", False) \
             if abort is None else abort
-        self._lock = threading.Lock()
+        self._lock = named_lock("guards.StepWatchdog")
         self._timer = None
         self._stopped = False
         # NOT armed at construction: monitoring starts at the first beat()

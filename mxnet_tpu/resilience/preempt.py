@@ -20,6 +20,7 @@ import logging
 import signal
 import threading
 
+from ..analysis.lockwatch import named_lock
 from ..base import MXNetError
 
 __all__ = ["TrainingPreempted", "PreemptionHandler", "preemption_requested"]
@@ -37,7 +38,7 @@ class TrainingPreempted(MXNetError):
 class PreemptionHandler:
     """Process-wide SIGTERM flag (install/uninstall are refcounted)."""
 
-    _lock = threading.Lock()
+    _lock = named_lock("preempt.handler")
     _refs = 0
     _prev = None
     _requested = False

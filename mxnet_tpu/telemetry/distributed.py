@@ -44,6 +44,7 @@ import os
 import statistics
 import threading
 
+from ..analysis.lockwatch import named_lock
 from .hub import hub as _hub, set_rank_provider
 
 __all__ = ["trace_id", "set_trace_id", "set_world", "current_rank",
@@ -51,7 +52,7 @@ __all__ = ["trace_id", "set_trace_id", "set_world", "current_rank",
            "emit_server_span", "record_clock_beacon", "clock_offsets",
            "merge_traces", "detect_stragglers", "load_rank_streams"]
 
-_LOCK = threading.Lock()
+_LOCK = named_lock("telemetry.distributed.identity")
 _TLS = threading.local()
 _STATE = {"trace_id": None, "rank": 0, "world_size": 1}
 

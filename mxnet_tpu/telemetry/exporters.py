@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 import threading
 
+from ..analysis.lockwatch import named_lock
 from .hub import hub as _hub, _rank_world
 
 __all__ = ["SCHEMA_VERSION", "EVENT_GOLDEN_KEYS", "JsonlWriter",
@@ -48,6 +49,8 @@ EVENT_GOLDEN_KEYS = {
     "flight_dump": ("reason", "path"),
     "watchdog": ("deadline",),
     "chaos": ("site",),
+    # concurrency watchdog (ISSUE 11): cycle/stall incidents
+    "lockwatch": ("what",),
     # elastic training (ISSUE 10)
     "resize": ("from_world", "to_world", "reason", "membership_epoch"),
     # memory observability (ISSUE 9)
@@ -70,7 +73,7 @@ class JsonlWriter:
     def __init__(self, path, only_rank=None):
         self.path = path
         self.only_rank = only_rank
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.exporters.JsonlWriter")
         self._f = open(path, "a", encoding="utf-8")
 
     def write_event(self, event):
@@ -193,7 +196,7 @@ def prom_dump(h=None) -> str:
 # -- background HTTP endpoint --------------------------------------------------
 
 _SERVER = None
-_SERVER_LOCK = threading.Lock()
+_SERVER_LOCK = named_lock("telemetry.exporters.http")
 
 
 def serve_http(port):
@@ -226,7 +229,7 @@ def serve_http(port):
         server = http.server.ThreadingHTTPServer(("0.0.0.0", int(port)),
                                                  Handler)
         thread = threading.Thread(target=server.serve_forever,
-                                  name="mxtpu-metrics-http", daemon=True)
+                                  name="mx-metrics-http", daemon=True)
         thread.start()
         _SERVER = server
         return server.server_address[1]

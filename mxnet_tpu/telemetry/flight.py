@@ -39,6 +39,7 @@ import sys
 import threading
 import zlib
 
+from ..analysis.lockwatch import named_lock
 from .hub import hub as _hub, on_hub_create
 
 __all__ = ["FlightRecorder", "INCIDENT_KINDS", "recorder", "reset",
@@ -50,7 +51,7 @@ FLIGHT_FORMAT = 1
 # event kinds that are incidents: the "what went wrong" ring
 INCIDENT_KINDS = frozenset({
     "retry", "circuit_open", "step_event", "server_dedup", "watchdog",
-    "chaos", "badput", "guard_trip", "preempt", "memory_leak",
+    "chaos", "badput", "guard_trip", "preempt", "memory_leak", "lockwatch",
 })
 
 
@@ -72,7 +73,7 @@ class FlightRecorder:
     non-span, non-incident event is one dict get + one set lookup."""
 
     def __init__(self, k_steps=64, k_events=512, k_incidents=256):
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.flight.FlightRecorder")
         self._k_events = int(k_events)
         self._steps = collections.deque(maxlen=int(k_steps))
         self._incidents = collections.deque(maxlen=int(k_incidents))
@@ -174,7 +175,10 @@ class FlightRecorder:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
-        self.dump_count += 1
+        with self._lock:
+            # dump() runs from the excepthook chain, preempt flush, and
+            # manual calls concurrently — count under the ring lock
+            self.dump_count += 1
         h.emit("flight_dump", reason=str(reason), path=path,
                steps=len(steps), incidents=len(incidents))
         return path
@@ -200,7 +204,7 @@ def validate_flight(path):
 # -- process-global recorder ---------------------------------------------------
 
 _RECORDER = None
-_LOCK = threading.Lock()
+_LOCK = named_lock("telemetry.flight.global")
 _INSTALLED = False
 _PREV_EXCEPTHOOK = None
 

@@ -31,6 +31,8 @@ import collections
 import threading
 import time
 
+from ..analysis.lockwatch import named_lock
+
 __all__ = ["MetricsHub", "Histogram", "hub", "reset", "DEFAULT_COUNTERS",
            "set_rank_provider", "on_hub_create"]
 
@@ -143,7 +145,7 @@ class MetricsHub:
     --telemetry-bench asserts it stays under 2% of a smoke-run step)."""
 
     def __init__(self, ring_size=8192):
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.hub.MetricsHub")
         self._counters = {}          # (name, labelkey) -> float
         self._gauges = {}            # (name, labelkey) -> float
         self._hists = {}             # (name, labelkey) -> Histogram
@@ -304,7 +306,7 @@ class MetricsHub:
 
 
 _HUB = None
-_HUB_LOCK = threading.Lock()
+_HUB_LOCK = named_lock("telemetry.hub.global")
 _ON_CREATE = []  # callbacks run on every fresh hub (flight recorder attach)
 
 

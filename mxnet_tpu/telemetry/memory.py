@@ -54,6 +54,7 @@ import os
 import threading
 import weakref
 
+from ..analysis.lockwatch import named_lock, named_rlock
 from ..base import MXNetError
 from ..utils.compile import MEMORY_PLAN_FIELDS as PLAN_FIELDS
 from .hub import hub as _hub, on_hub_create
@@ -163,7 +164,7 @@ class ArrayLedger:
         # (e.g. the dict insert in add() triggers collection of a tracked
         # NDArray) runs _on_dead synchronously on the same thread — a
         # plain Lock would self-deadlock inside NDArray.__init__
-        self._lock = threading.RLock()
+        self._lock = named_rlock("telemetry.memory.ArrayLedger")
         # buffer-keyed accounting: NDArray(existing) / same-device
         # as_in_context share ONE jax.Array — counting wrappers would
         # double-book the buffer and fake watermark drift. Keyed by
@@ -315,7 +316,7 @@ def detach_sampler():
 
 # -- epoch watermarks + leak detector ------------------------------------------
 
-_LEAK_LOCK = threading.Lock()
+_LEAK_LOCK = named_lock("telemetry.memory.leak")
 _EPOCH_MARKS: list = []   # (epoch, watermark_bytes)
 _LEAK_STREAK = [0]
 

@@ -46,8 +46,9 @@ _MEASURED_PEAK = {}  # backend platform -> measured FLOP/s per device
 # goes through record_compile_badput, which only counts seconds above the
 # high-water mark.
 import threading as _threading
+from ..analysis.lockwatch import named_lock as _named_lock
 
-_COMPILE_WM_LOCK = _threading.Lock()
+_COMPILE_WM_LOCK = _named_lock("telemetry.mfu.compile_wm")
 _COMPILE_WM = [None]  # None until the first observation window
 
 

@@ -56,6 +56,7 @@ import time
 import jax
 import numpy as np
 
+from ..analysis.lockwatch import named_lock
 from ..base import MXNetError
 
 __all__ = [
@@ -198,7 +199,7 @@ class ProgramRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("compile.ProgramRegistry")
         self._tls = threading.local()
         self.reset()
 

@@ -192,6 +192,42 @@ def test_bench_elastic_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_ELASTIC_*
 
 
+def test_bench_lockwatch_smoke_json_contract():
+    """--lockwatch-bench --smoke is the CI guard on the lock-order
+    watchdog bench (ISSUE 11): one JSON line with the contract keys,
+    ZERO lock-order cycles across both soaks (group-kvstore membership
+    churn + elastic-resize fit), the kvstore soak finishing without a
+    hang, and the acceptance bound — watchdog overhead under 2% of a
+    dp-4 step (priced per-pair x acquisitions/step, robust to
+    shared-box noise)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--lockwatch-bench", "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "pair_ns_off",
+                "pair_ns_on", "pair_delta_ns", "acquires_per_step",
+                "step_ms", "cycles", "max_hold_ms", "kv_soak",
+                "resizes", "worlds"):
+        assert key in blob, blob
+    assert blob["metric"] == "lockwatch_overhead_pct_of_step"
+    # ACCEPTANCE: zero lock-order cycles in both soaks, no kv hang
+    assert blob["cycles"] == 0, blob
+    assert blob["kv_soak"]["cycles"] == 0, blob
+    assert blob["kv_soak"]["hung"] is False, blob
+    # ACCEPTANCE: the armed watchdog costs <2% of a step
+    assert 0 <= blob["value"] < 2.0, blob
+    assert blob["pair_ns_on"] > blob["pair_ns_off"] > 0
+    assert blob["acquires_per_step"] > 0 and blob["step_ms"] > 0
+    # both elastic resizes committed under the watchdog
+    assert blob["resizes"] == 2 and blob["worlds"] == [3, 4], blob
+    assert blob["smoke"] is True  # smoke runs never write BENCH_LOCKWATCH_*
+
+
 @pytest.mark.slow
 def test_bench_pipeline_mode_json_contract(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")

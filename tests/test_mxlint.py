@@ -865,6 +865,305 @@ def test_jaxpr_audit_costs_and_promotion():
     assert "MX502" in [f.rule.id for f in rep2.findings]
 
 
+# -- MX70x: concurrency pass (ISSUE 11) ---------------------------------------
+
+def _cc_ids(src):
+    from mxnet_tpu.analysis import concurrency
+
+    return [f.rule.id for f in concurrency.lint_source(src, "fx.py")]
+
+
+def test_fixture_mx701_unlocked_shared_attr():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "        self._t = threading.Thread(target=self._work,\n"
+        "                                   daemon=True)\n"
+        "    def _work(self):\n"
+        "        self.count += 1\n"
+        "    def bump(self):\n"
+        "        self.count += 1\n"
+    )
+    findings = [f for f in _cc_ids(src)]
+    assert findings == ["MX701"]
+
+
+def test_fixture_mx701_common_lock_is_clean():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "        self._t = threading.Thread(target=self._work,\n"
+        "                                   daemon=True)\n"
+        "    def _work(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n"
+    )
+    assert _cc_ids(src) == []
+
+
+def test_fixture_mx701_weakref_callback_and_container_mutator():
+    """GC-callback entry point + .append() mutator (the ledger shape)."""
+    src = (
+        "import threading\n"
+        "import weakref\n"
+        "class Ledger:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.rows = []\n"
+        "    def add(self, arr):\n"
+        "        ref = weakref.ref(arr, self._on_dead)\n"
+        "        self.rows.append(ref)\n"
+        "    def _on_dead(self, ref):\n"
+        "        self.rows.remove(ref)\n"
+    )
+    assert _cc_ids(src) == ["MX701"]
+
+
+def test_fixture_mx701_private_helper_under_lock_is_clean():
+    """The guaranteed-held-lock inference: a private helper whose every
+    call site holds the lock needs no pragma."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "        self._t = threading.Thread(target=self._work,\n"
+        "                                   daemon=True)\n"
+        "    def _bump_locked(self):\n"
+        "        self.n += 1\n"
+        "    def _work(self):\n"
+        "        with self._lock:\n"
+        "            self._bump_locked()\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._bump_locked()\n"
+    )
+    assert _cc_ids(src) == []
+
+
+def test_fixture_mx702_lock_order_inversion():
+    src = (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def f():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with B:\n"
+        "        with A:\n"
+        "            pass\n"
+    )
+    assert _cc_ids(src) == ["MX702"]
+
+
+def test_fixture_mx702_consistent_order_is_clean():
+    src = (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def f():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+    )
+    assert _cc_ids(src) == []
+
+
+def test_fixture_mx702_via_call_hop():
+    """The one-hop edge: holding A while calling a method that takes B,
+    against a method taking them in the other order."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def _take_b(self):\n"
+        "        with self._b:\n"
+        "            pass\n"
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            self._take_b()\n"
+        "    def g(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    assert "MX702" in _cc_ids(src)
+
+
+def test_fixture_mx703_bare_wait():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.lock = threading.Lock()\n"
+        "        self.cv = threading.Condition(self.lock)\n"
+        "    def bad(self):\n"
+        "        with self.cv:\n"
+        "            self.cv.wait()\n"
+    )
+    assert _cc_ids(src) == ["MX703"]
+
+
+def test_fixture_mx703_wait_for_and_loop_are_clean():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.lock = threading.Lock()\n"
+        "        self.cv = threading.Condition(self.lock)\n"
+        "        self.ready = False\n"
+        "    def ok1(self):\n"
+        "        with self.cv:\n"
+        "            self.cv.wait_for(lambda: self.ready)\n"
+        "    def ok2(self):\n"
+        "        with self.cv:\n"
+        "            while not self.ready:\n"
+        "                self.cv.wait()\n"
+    )
+    assert _cc_ids(src) == []
+
+
+def test_fixture_mx704_unjoined_non_daemon_thread():
+    src = (
+        "import threading\n"
+        "def spawn():\n"
+        "    t = threading.Thread(target=print)\n"
+        "    t.start()\n"
+    )
+    assert _cc_ids(src) == ["MX704"]
+
+
+def test_fixture_mx704_daemon_or_joined_is_clean():
+    src = (
+        "import threading\n"
+        "def ok1():\n"
+        "    threading.Thread(target=print, daemon=True).start()\n"
+        "def ok2():\n"
+        "    t = threading.Thread(target=print)\n"
+        "    t.start()\n"
+        "    t.join()\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=print)\n"
+        "        self._t.start()\n"
+        "    def stop(self):\n"
+        "        self._t.join()\n"
+    )
+    assert _cc_ids(src) == []
+
+
+def test_fixture_mx705_fresh_lock():
+    """The real-world citation: comm/stats.py:161 (pre-fix) locked
+    `getattr(self, '_lock', threading.Lock())` — a fresh private lock
+    whenever _lock was missing, guarding nothing."""
+    src = (
+        "import threading\n"
+        "class R:\n"
+        "    def reset(self):\n"
+        "        with getattr(self, '_lock', threading.Lock()):\n"
+        "            self.x = 1\n"
+        "def direct():\n"
+        "    with threading.Lock():\n"
+        "        pass\n"
+    )
+    ids = _cc_ids(src)
+    assert ids == ["MX705", "MX705"]
+
+
+def test_fixture_mx705_reused_lock_is_clean():
+    src = (
+        "import threading\n"
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def reset(self):\n"
+        "        with self._lock:\n"
+        "            self.x = 1\n"
+    )
+    assert _cc_ids(src) == []
+
+
+def test_fixture_mx70x_pragma_suppression():
+    src = (
+        "import threading\n"
+        "def spawn():\n"
+        "    t = threading.Thread(target=print)  "
+        "# mxlint: disable=MX704 - joined by the caller\n"
+        "    t.start()\n"
+    )
+    assert _cc_ids(src) == []
+
+
+def test_concurrency_lockwatch_factory_counts_as_lock_ctor():
+    """Locks built by the analysis.lockwatch factory are first-class in
+    the static model: same rules, same aliasing."""
+    src = (
+        "from mxnet_tpu.analysis.lockwatch import named_condition, "
+        "named_lock\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.lock = named_lock('s')\n"
+        "        self.cv = named_condition('s.cv', self.lock)\n"
+        "    def bad(self):\n"
+        "        with self.cv:\n"
+        "            self.cv.wait()\n"
+    )
+    assert _cc_ids(src) == ["MX703"]
+
+
+def test_self_lint_concurrency_clean():
+    """ISSUE 11 gate: the tree self-lints MX701-MX705 clean (fixed or
+    pragma'd with a justification)."""
+    from mxnet_tpu.analysis import concurrency
+
+    findings = [f for f in concurrency.lint_paths(
+        [os.path.join(REPO, "mxnet_tpu")])
+        if f.rule.id.startswith("MX70")]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_cli_concurrency_flag(tmp_path):
+    """`python -m mxnet_tpu.analysis --concurrency` reports MX70x."""
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "import threading\n"
+        "def spawn():\n"
+        "    t = threading.Thread(target=print)\n"
+        "    t.start()\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--concurrency",
+         str(bad)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr  # warning-grade
+    assert "MX704" in proc.stdout
+    # and --warnings-as-errors promotes it to a failing exit
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--concurrency",
+         "--warnings-as-errors", str(bad)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=240)
+    assert proc.returncode == 1
+
+
 # -- the self-lint gate -------------------------------------------------------
 
 def test_self_lint_package_clean():

@@ -147,6 +147,9 @@ def test_jsonl_schema_golden_keys(tmp_path):
            watermark_bytes=8 << 20)
     h.emit("memory_preflight", what="fit", total_bytes=4096,
            budget_bytes=None, fits=True)
+    # concurrency watchdog kind (ISSUE 11)
+    h.emit("lockwatch", what="cycle", cycle="a->b", closing_edge="b->a",
+           thread="mx-kv-serve-1")
     path = str(tmp_path / "events.jsonl")
     telemetry.write_jsonl(path, h.events())
     rows = telemetry.read_jsonl(path)
