@@ -1385,6 +1385,257 @@ def run_elastic_bench(args):
         print(f"wrote {out}", file=sys.stderr)
 
 
+def run_controller_bench(args):
+    """--controller-bench: goodput recovered by the fleet controller
+    under an injected persistent straggler + a flaky rank (ISSUE 12).
+
+    Three dp-8 fits on the CPU mesh, same model/data/steps:
+
+      clean    no fault injected, no controller — the ceiling;
+      static   rank 7 drags every collective by a fixed stall (injected
+               as a real per-step sleep + per-rank telemetry spans that
+               blame it) and rank 6 goes heartbeat-silent mid-run; no
+               controller, so the fleet pays the stall forever;
+      armed    same faults, fit(controller=...): the controller blames
+               rank 7 over K-of-N windows, evicts it, backfills the
+               flaky rank when it beats again, and auto-picks a
+               compression tier from the (bandwidth-scaled) comm:compute
+               ratio.
+
+    Headline: goodput_recovered_frac = (tpc_armed - tpc_static) /
+    (tpc_clean - tpc_static) on per-chip throughput over the post-
+    warmup epochs — 1.0 means the autopilot bought back everything the
+    straggler cost. Emits one JSON line; full runs write
+    BENCH_CONTROLLER_r15.json."""
+    import tempfile
+    import threading
+    import time as _time
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.resilience import ElasticCoordinator, FleetController
+
+    import jax
+
+    world = 8
+    if len(jax.devices()) < world:
+        print(json.dumps({"metric": "controller_goodput_recovered_frac",
+                          "value": 0, "unit": "frac", "vs_baseline": 0,
+                          "error": f"need {world} devices"}))
+        return
+    smoke = args.smoke
+    dim, hidden, classes = (32, 64, 4) if smoke else (128, 512, 16)
+    # batch % 6, 7, 8 == 0: every world this fleet can pass through
+    # (evict the straggler -> 7, flaky death -> 6, backfill -> 7/8)
+    batch, n_rows = (168, 840) if smoke else (168, 3360)
+    epochs = 3 if smoke else 5
+    stall_s = 0.03 if smoke else 0.05
+    straggler, flaky = 7, 6
+    steps_per_epoch = n_rows // batch
+
+    def build():
+        data = mx.sym.Variable("data")
+        h1 = mx.sym.Activation(mx.sym.FullyConnected(
+            data, name="fc1", num_hidden=hidden), name="a1",
+            act_type="tanh")
+        out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+            h1, name="fc2", num_hidden=classes), name="softmax")
+        return mx.FeedForward(out, ctx=[mx.cpu(i) for i in range(world)],
+                              num_epoch=epochs, optimizer="sgd",
+                              learning_rate=0.05)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_rows, dim).astype(np.float32)
+    y = rng.randint(0, classes, (n_rows,)).astype(np.float32)
+    telemetry.measured_peak_flops()  # cache the probe outside timing
+
+    class FaultHarness:
+        """The injected fleet pathology: a persistent straggler (real
+        per-step sleep, charged to whoever keeps rank 7 in the world)
+        plus per-rank telemetry spans blaming it, and a flaky rank whose
+        out-of-band heartbeats stop for a while mid-run and resume.
+        Heartbeats come from their own thread (like a real fleet's —
+        and so a long AOT re-warm gap can never read as a mass death).
+        With ``inject=False`` it is the clean harness: same bookkeeping
+        (per-step wall clocks), no faults."""
+
+        def __init__(self, co=None, inject=True):
+            self.co = co
+            self.inject = inject
+            self.step = 0
+            self.times = []  # monotonic at every batch callback
+            self._stop = threading.Event()
+            self._silent_at = None  # wall start of the flaky outage
+            if co is not None and co.heartbeat_timeout:
+                self._silence = 6.0 * co.heartbeat_timeout
+                threading.Thread(target=self._beat, daemon=True,
+                                 name="mx-bench-beater").start()
+
+        def alive(self):
+            return self.co.alive if self.co is not None \
+                else tuple(range(world))
+
+        def _beat(self):
+            # every rank beats (departed ones too: a recovered host
+            # heartbeats before readmission) except the flaky one
+            # during its outage window
+            while not self._stop.wait(0.05):
+                now = _time.monotonic()
+                out = self._silent_at is not None and \
+                    now - self._silent_at < self._silence
+                for r in range(world):
+                    if r == flaky and out:
+                        continue
+                    self.co.heartbeat(r)
+
+        def close(self):
+            self._stop.set()
+
+        def final_epoch_step_s(self):
+            """Median wall per step over the run's final epoch — the
+            steady state each fleet settled into (run-order XLA-cache
+            effects and mid-run re-warms excluded by construction)."""
+            tail = self.times[-(steps_per_epoch + 1):]
+            diffs = sorted(b - a for a, b in zip(tail, tail[1:]))
+            return diffs[len(diffs) // 2] if diffs else None
+
+        def __call__(self, param):
+            del param
+            self.times.append(_time.monotonic())
+            s = self.step
+            self.step += 1
+            if not self.inject:
+                return
+            if self.co is not None and self._silent_at is None and \
+                    s >= steps_per_epoch:
+                self._silent_at = _time.monotonic()  # outage starts
+            alive = self.alive()
+            if straggler in alive:
+                _time.sleep(stall_s)  # the whole collective waits
+            for r in alive:
+                dur_ms = (stall_s * 1e3 + 2.0) if r == straggler else 2.0
+                telemetry.emit(
+                    "span", rank=r, name="step", epoch=0, step=s,
+                    dur_ms=dur_ms,
+                    phases=[{"name": "device", "dur_ms": dur_ms}])
+
+    def run_fit(name, faults, controller=None, co=None):
+        telemetry.reset()
+        model = build()
+        tmp = tempfile.mkdtemp(prefix=f"mxtpu_ctl_bench_{name}_")
+        t0 = _time.perf_counter()
+        try:
+            model.fit(X, y, batch_size=batch,
+                      # False, not None: a user's MXNET_TPU_ELASTIC /
+                      # MXNET_TPU_CONTROLLER env gates must not arm the
+                      # clean/static baselines
+                      elastic=co if co is not None else False,
+                      controller=controller if controller is not None
+                      else False,
+                      sharded_checkpoint_dir=os.path.join(tmp, "ckpt")
+                      if co is not None else None,
+                      batch_end_callback=faults,
+                      telemetry=telemetry.TelemetryConfig(
+                          timeline=False, memory=False))
+        finally:
+            if hasattr(faults, "close"):
+                faults.close()
+        wall = _time.perf_counter() - t0
+        return model, wall
+
+    clean = FaultHarness(inject=False)   # the no-fault ceiling
+    _, wall_clean = run_fit("clean", clean)
+    static = FaultHarness()
+    _, wall_static = run_fit("static", static)
+
+    co = ElasticCoordinator(world, heartbeat_timeout=0.5)
+    ctl = FleetController(
+        interval=0.0, window=24, min_report_steps=24, evict_k=3,
+        evict_n=5, max_evictions=1, rejoin_after=1.0, evaluate_after=1.0,
+        cooldowns={"evict": 0.5, "backfill": 0.2, "retier": 0.5},
+        wire_gbps=0.01)  # scaled bandwidth: the tiny CPU model reads as
+    #                      comm-bound, so the tier policy has a real
+    #                      choice to make on this rig
+    harness = FaultHarness(co)
+    model, wall_ctl = run_fit("armed", harness, controller=ctl, co=co)
+
+    # per-chip throughput in each run's FINAL-epoch steady state
+    # (steps/sec/chip, global batch fixed): the static fleet is still
+    # paying the straggler there; the armed fleet has evicted it and
+    # settled on its chosen world/tier. Whole-run walls are reported
+    # too, but run-order XLA-executable-cache effects make them
+    # incomparable as the headline.
+    worlds = [h["to"] for h in co.history]
+    step_clean = clean.final_epoch_step_s()
+    step_static = static.final_epoch_step_s()
+    step_ctl = harness.final_epoch_step_s()
+    tpc_clean = 1.0 / (step_clean * world) if step_clean else None
+    tpc_static = 1.0 / (step_static * world) if step_static else None
+    tpc_ctl = 1.0 / (step_ctl * co.world_size) if step_ctl else None
+    recovered = None
+    if None not in (tpc_clean, tpc_static, tpc_ctl) and \
+            tpc_clean > tpc_static:
+        recovered = (tpc_ctl - tpc_static) / (tpc_clean - tpc_static)
+
+    evicts = [d for d in ctl.decisions
+              if d["lever"] == "evict" and d["outcome"] == "actuated"]
+    backfills = [d for d in ctl.decisions
+                 if d["lever"] == "backfill" and d["outcome"] == "actuated"]
+    retiers = [d for d in ctl.decisions
+               if d["lever"] == "retier" and d["outcome"] == "actuated"]
+
+    result = {
+        "metric": "controller_goodput_recovered_frac",
+        "value": round(recovered, 4) if recovered is not None else None,
+        "unit": "frac",
+        "vs_baseline": round(tpc_ctl / tpc_static, 4)
+        if tpc_ctl and tpc_static else None,
+        "tpc_clean": round(tpc_clean, 4) if tpc_clean else None,
+        "tpc_static": round(tpc_static, 4) if tpc_static else None,
+        "tpc_controller": round(tpc_ctl, 4) if tpc_ctl else None,
+        "final_step_ms": {
+            "clean": round(step_clean * 1e3, 3) if step_clean else None,
+            "static": round(step_static * 1e3, 3) if step_static else None,
+            "controller": round(step_ctl * 1e3, 3) if step_ctl else None},
+        "wall_clean_s": round(wall_clean, 3),
+        "wall_static_s": round(wall_static, 3),
+        "wall_controller_s": round(wall_ctl, 3),
+        "stall_ms": stall_s * 1e3,
+        "evicted": [d.get("rank") for d in evicts],
+        "backfilled": [d.get("rank") for d in backfills],
+        "tier_chosen": ctl._comm_mode,
+        "retier_actions": [d["action"] for d in retiers],
+        "resizes": co.resizes,
+        "worlds": worlds,
+        "breaker_state": ctl.breaker.state,
+        "decisions_total": len(ctl.decisions),
+        "epochs": epochs, "steps_per_epoch": steps_per_epoch,
+        "batch": batch, "full_world": world, "smoke": bool(smoke),
+        "notes": (
+            "headline = fraction of straggler-lost per-chip throughput "
+            "the armed controller bought back, measured in each run's "
+            "final-epoch steady state (the static fleet still pays the "
+            "stall there; the armed fleet has evicted the straggler and "
+            "settled on its chosen world/tier). Whole-run walls carry "
+            "the autopilot's own costs (resize + retier re-warms) and "
+            "run-order XLA-cache effects — reported, not the headline. "
+            "CPU-rig caveat: stall_ms dominates the tiny step, so "
+            "fractions exaggerate what a pod would see; the shape of "
+            "the loop (blame -> evict -> backfill -> retier) is the "
+            "measured artifact."),
+    }
+    print(json.dumps(result))
+    if not smoke:
+        assert recovered is not None and recovered >= 0.3, result
+        assert [d.get("rank") for d in evicts] == [straggler], result
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_CONTROLLER_r15.json")
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(f"wrote {out}", file=sys.stderr)
+
+
 def run_lockwatch_bench(args):
     """--lockwatch-bench: price the runtime lock-order watchdog (ISSUE 11).
 
@@ -1601,6 +1852,13 @@ def main():
                          "to 8) and post-resize goodput on the CPU mesh; "
                          "emits one JSON line, full runs write "
                          "BENCH_ELASTIC_r13.json")
+    ap.add_argument("--controller-bench", action="store_true",
+                    help="fleet-controller acceptance (ISSUE 12): inject "
+                         "a persistent straggler + flaky rank into dp-8 "
+                         "fits with and without the armed controller; "
+                         "headline = fraction of per-chip goodput "
+                         "recovered -> BENCH_CONTROLLER_r15.json (one "
+                         "JSON line with --smoke)")
     ap.add_argument("--lockwatch-bench", action="store_true",
                     help="price the runtime lock-order watchdog (ISSUE "
                          "11): group-kvstore churn + elastic-resize fit "
@@ -1708,6 +1966,17 @@ def main():
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8").strip()
         run_elastic_bench(args)
+        return
+
+    if args.controller_bench:
+        # same CPU-mesh rig: the sense->decide->actuate loop (blame,
+        # evict, backfill, retier) runs end-to-end on the virtual world
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        run_controller_bench(args)
         return
 
     if args.compile_bench_child:

@@ -216,6 +216,21 @@ register_rule(
     "closure sees the current world")
 
 register_rule(
+    "MX311", "warning",
+    "direct fleet actuation outside the policy loop: a call to "
+    "ElasticCoordinator.kill/request_world or "
+    "set_gradient_compression outside resilience/controller.py (and "
+    "tests/examples) — actuation that bypasses the FleetController "
+    "skips its safety rails (K-of-N hysteresis, per-lever cooldowns, "
+    "dry-run, rate limits, the controller circuit breaker) and leaves "
+    "no `controller` decision event for telemetry diff / flight "
+    "post-mortems to gate on (ISSUE 12)",
+    "route the change through FleetController (fit(controller=...), or "
+    "coordinator-level policies it already owns); a deliberate "
+    "out-of-loop site (launcher setup, recovery tooling) carries "
+    "`# mxlint: disable=MX311` with a justification")
+
+register_rule(
     "MX306", "warning",
     "un-barriered wall-clock delta around device dispatch: a "
     "time.time()/perf_counter() start/stop pair with work between and no "

@@ -23,6 +23,10 @@ Catches, before anything imports or traces:
                outside the mesh/coordinator providers — a size frozen at
                build time goes stale when the elastic world resizes
                mid-run (derive from the live mesh/kvstore/coordinator),
+  MX311        direct fleet actuation (ElasticCoordinator.kill/
+               request_world, set_gradient_compression) outside
+               resilience/controller.py — actuation must flow through
+               the FleetController policy loop and its safety rails,
   MX601-602    robustness hazards (bare ``except:``; ``while True`` retry
                loops that swallow exceptions with no backoff/deadline —
                the loop shape that melts a parameter server under a
@@ -906,6 +910,71 @@ def _scan_unpinned_collectives(tree, path, findings):
                     path=path, line=lineno, col=col))
 
 
+# -- MX311: fleet actuation outside the policy loop ---------------------------
+# ISSUE 12: actuation must flow through resilience/controller.py so every
+# membership/tier change carries the controller's safety rails (hysteresis,
+# cooldowns, dry-run, breaker) and lands in the decision log. The scan is
+# zero-FP-biased: `.request_world(` and `.set_gradient_compression(` are
+# distinctive enough to flag anywhere in scope; `.kill(` only fires when
+# the receiver's name says coordinator (`co`, `*coord*`, `*elastic*` —
+# `os.kill` / `proc.kill` never match). Definition sites are exempt
+# (controller.py IS the policy loop, elastic.py OWNS the lever), as are
+# tests, examples, and lint fixtures; intentional out-of-loop sites carry
+# `# mxlint: disable=MX311` with a justification.
+
+_MX311_METHODS = frozenset({"kill", "request_world",
+                            "set_gradient_compression"})
+_MX311_EXEMPT_FILES = ("controller.py", "elastic.py")
+
+
+def _mx311_exempt(path: str) -> bool:
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if any(p in ("tests", "examples", "fixtures") for p in parts):
+        return True
+    base = os.path.basename(norm)
+    return base in _MX311_EXEMPT_FILES or base.startswith("test_")
+
+
+def _mx311_receiver_is_coordinator(func: ast.Attribute) -> bool:
+    recv = func.value
+    name = None
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    if name is None:
+        return False
+    low = name.lower()
+    return low == "co" or "coord" in low or "elastic" in low
+
+
+def _scan_fleet_actuation(tree, path, findings):
+    if _mx311_exempt(path):
+        return
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        name = node.func.attr
+        if name not in _MX311_METHODS:
+            continue
+        if name == "kill" and \
+                not _mx311_receiver_is_coordinator(node.func):
+            continue  # os.kill / process.kill are not fleet actuation
+        recv = node.func.value
+        if isinstance(recv, ast.Call) and \
+                getattr(recv.func, "id", None) == "super":
+            continue  # an override delegating to its base is not a site
+        findings.append(Finding(
+            get_rule("MX311"),
+            f"direct fleet actuation `.{name}(...)` outside "
+            "resilience/controller.py — membership/compression-tier "
+            "changes must flow through the FleetController policy loop "
+            "(hysteresis, cooldowns, dry-run, breaker, decision log)",
+            path=path, line=node.lineno, col=node.col_offset))
+
+
 # calls whose presence inside a retry loop counts as bounding it: anything
 # sleep/backoff/wait-shaped (time.sleep, policy backoff, cv.wait_for, ...)
 _BOUNDING_CALL_PARTS = ("sleep", "backoff", "wait", "delay", "retry_call",
@@ -1020,6 +1089,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     _scan_unpinned_collectives(tree, path, scan.findings)
     _scan_step_loop_syncs(tree, path, scan.imports, scan.findings)
     _scan_world_literal_closures(tree, path, scan.findings)
+    _scan_fleet_actuation(tree, path, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)
     roots += [d for d in scan.defs if d.name in scan.traced_names]

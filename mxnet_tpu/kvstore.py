@@ -798,5 +798,7 @@ def create_group(num_workers: int, kv_type="dist_sync", compression=None,
     workers = [_GroupWorkerKVStore(server, r) for r in range(num_workers)]
     if compression is not None:
         for w in workers:
-            w.set_gradient_compression(compression)
+            # group construction applying the caller's static spec —
+            # setup, not a mid-run tier change
+            w.set_gradient_compression(compression)  # mxlint: disable=MX311 - launch config, not mid-run actuation
     return workers

@@ -910,7 +910,8 @@ class FeedForward(BASE_ESTIMATOR):
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, batch_size=128,
             sharded_checkpoint_dir=None, guards=None, pad_policy=None,
-            compression=None, overlap=None, telemetry=None, elastic=None):
+            compression=None, overlap=None, telemetry=None, elastic=None,
+            controller=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -1000,7 +1001,23 @@ class FeedForward(BASE_ESTIMATOR):
         ``sharded_checkpoint_dir`` and a multi-device ctx list; downtime
         is priced into goodput as a ``resize`` badput bucket and appears
         in traces as coordinator spans
-        (doc/developer-guide/resilience.md, "Elastic training")."""
+        (doc/developer-guide/resilience.md, "Elastic training").
+
+        ``controller``: the self-driving fleet policy loop — None
+        (default; env gate ``MXNET_TPU_CONTROLLER``, value ``dry`` for
+        recommend-only), True, a FleetControllerConfig, or a
+        resilience.FleetController. When armed, the loop ticks the
+        controller once per step (unless it runs on its own
+        ``mx-fleet-ctl`` thread): it watches the live telemetry
+        (streaming straggler blame, goodput-per-chip, comm:compute
+        ratio), evicts consistently-blamed stragglers and backfills
+        them through the elastic coordinator (pass ``elastic=`` to arm
+        the membership levers), and stages compression-tier/overlap-cap
+        changes that this loop applies through the AOT re-warm path.
+        Every decision is a ``controller`` event + flight-recorder
+        incident; its own circuit breaker freezes actuation (never the
+        fit) on failures or goodput regressions
+        (doc/developer-guide/resilience.md, "Fleet controller")."""
         del work_load_list
         guard_cfg = guards_mod.GuardConfig.resolve(guards)
         pad_policy = compile_mod.PadPolicy.resolve(pad_policy)
@@ -1086,7 +1103,10 @@ class FeedForward(BASE_ESTIMATOR):
             # host-transport compression: grads cross the parameter-host
             # socket quantized+bucketed (kvstore_async.py); no in-jit comm
             if hasattr(kv, "set_gradient_compression"):
-                kv.set_gradient_compression(comm_spec)
+                # fit-setup wiring of the USER'S static spec, before any
+                # step runs — mid-run tier changes go through the
+                # controller's retier lever
+                kv.set_gradient_compression(comm_spec)  # mxlint: disable=MX311 - launch config, not mid-run actuation
                 async_comm_spec = comm_spec
             comm_spec = None
         elif comm_spec is not None and mesh is None:
@@ -1259,6 +1279,30 @@ class FeedForward(BASE_ESTIMATOR):
 
         cstate, resid_layout_key = _build_comm_state(resume_comm_state,
                                                      resume_comm_layout)
+
+        # -- fleet controller (ISSUE 12): the policy loop closing the
+        # telemetry -> actuation gap (doc/developer-guide/resilience.md,
+        # "Fleet controller"). Membership levers actuate through the
+        # elastic coordinator above; tier changes are staged by the
+        # controller and applied by this loop via _apply_retier.
+        from .resilience import controller as fleetctl_mod
+
+        fleet_ctl = fleetctl_mod.FleetController.resolve(controller)
+        if fleet_ctl is not None:
+            ndev_now = int(mesh.shape["dp"]) if mesh is not None else 1
+            fleet_ctl.bind(
+                coordinator=elastic_co,
+                model_key=str(self._fingerprint_for_bucket(None)),
+                world_size=ndev_now,
+                comm_mode=comm_spec.mode if comm_spec is not None
+                else "none",
+                can_retier=mesh is not None and not async_kv,
+                fp32_wire_bytes=comm_mod.fp32_allreduce_wire_bytes(
+                    comm_mod.flat_size(params), ndev_now)
+                if mesh is not None else 0.0,
+                logger=logger)
+            logger.info("controller: %s (%r)", fleet_ctl.state,
+                        fleet_ctl.cfg)
 
         # -- resilience wiring (all of it no-op when guards are off and no
         # checkpoint dir is given; the unguarded hot path is unchanged) ----
@@ -1530,8 +1574,14 @@ class FeedForward(BASE_ESTIMATOR):
                 self.precompile(
                     data_shapes=data_shapes, label_shapes=label_shapes,
                     eval_metric=eval_metric, guards=guard_cfg,
-                    pad_policy=pad_policy, compression=comm_spec,
-                    overlap=overlap_cfg,
+                    pad_policy=pad_policy,
+                    # False (not None): resolve(None) would re-read the
+                    # env gates and could resurrect a tier the controller
+                    # has since re-tiered away from
+                    compression=comm_spec if comm_spec is not None
+                    else False,
+                    overlap=overlap_cfg if overlap_cfg is not None
+                    else False,
                     batch_end_callback=batch_end_callback)
             finally:
                 if rspan is not None:
@@ -1543,6 +1593,63 @@ class FeedForward(BASE_ESTIMATOR):
                 "resize (ranks %s, checkpoint step %s, %d update(s))",
                 epoch, int(mesh.shape["dp"]), down, list(ev.ranks),
                 meta.get("step", "?"), num_update)
+
+        def _apply_retier(action):
+            """Controller-staged compression re-tier: rebuild the fused
+            step's comm path on the new tier through the AOT re-warm
+            path. Unlike a resize this touches no params/opt state and
+            redoes nothing — the next step dispatches the re-tiered
+            warmed program. EF residuals restart at zero (a tier change
+            invalidates their layout; dropping accumulated error is the
+            safe direction). Transactional: a failure restores the old
+            program set, counts against the controller's breaker, and
+            training continues un-retiered."""
+            nonlocal comm_spec, overlap_cfg, overlap_plan, cstate, \
+                resid_layout_key
+            old = (comm_spec, overlap_cfg, overlap_plan, cstate,
+                   resid_layout_key)
+            t0 = time.time()
+            try:
+                # quiesce: the in-flight step's donated buffers must
+                # retire before their program set is swapped out
+                jax.block_until_ready(
+                    jax.tree_util.tree_leaves(params)[:1])
+                mode = action["mode"]
+                comm_spec = None if mode == "none" \
+                    else comm_mod.CompressionSpec(mode)
+                overlap_cfg = None
+                overlap_plan = None
+                if comm_spec is not None and action.get("bucket_bytes"):
+                    overlap_cfg = comm_mod.OverlapConfig(
+                        action["bucket_bytes"])
+                    overlap_plan = comm_mod.plan_overlap(
+                        {k: tuple(params[k].shape) for k in param_names},
+                        comm_spec, int(mesh.shape["dp"]),
+                        max_bytes=overlap_cfg.bucket_bytes,
+                        symbol=self.symbol)
+                cstate, resid_layout_key = _build_comm_state(None, None)
+                train_steps.clear()
+                self.precompile(
+                    data_shapes=data_shapes, label_shapes=label_shapes,
+                    eval_metric=eval_metric, guards=guard_cfg,
+                    pad_policy=pad_policy,
+                    compression=comm_spec if comm_spec is not None
+                    else False,
+                    overlap=overlap_cfg if overlap_cfg is not None
+                    else False,
+                    batch_end_callback=batch_end_callback)
+                fleet_ctl.retier_applied(action, time.time() - t0)
+                logger.info(
+                    "controller: compression re-tiered to %s%s in %.2fs "
+                    "(ratio %s)", mode,
+                    f" + overlap cap {overlap_cfg.bucket_bytes}"
+                    if overlap_cfg is not None else "",
+                    time.time() - t0, action.get("ratio"))
+            except Exception as e:
+                (comm_spec, overlap_cfg, overlap_plan, cstate,
+                 resid_layout_key) = old
+                train_steps.clear()
+                fleet_ctl.actuation_failed("retier", e, logger=logger)
 
         if elastic_co is not None:
             from .utils import checkpoint as ckpt_mod
@@ -1599,6 +1706,17 @@ class FeedForward(BASE_ESTIMATOR):
             feed_src = _timed_feed(feed, tl) if tl is not None else feed
             try:
                 for batch, batch_arrays in feed_src:
+                    if fleet_ctl is not None:
+                        # policy tick (synchronous mode), then any staged
+                        # actuation that must run on the training thread
+                        # (tier re-warm). Evictions/backfills the tick
+                        # issues land in the coordinator and surface
+                        # through the elastic poll right below.
+                        if not fleet_ctl.threaded:
+                            fleet_ctl.tick()
+                        retier_act = fleet_ctl.take_retier()
+                        if retier_act is not None:
+                            _apply_retier(retier_act)
                     if elastic_co is not None:
                         # membership poll, once per step: chaos sites,
                         # heartbeat expiry, then any pending change —
@@ -1955,6 +2073,8 @@ class FeedForward(BASE_ESTIMATOR):
                 watchdog.stop()
             if preempt_handler is not None:
                 preempt_mod.PreemptionHandler.uninstall()
+            if fleet_ctl is not None:
+                fleet_ctl.unbind()
             if elastic_co is not None:
                 telemetry_mod.set_world(*elastic_prev_world)
             # a mid-step exception (preemption, retry exhaustion) can leave

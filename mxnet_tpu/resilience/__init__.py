@@ -18,6 +18,13 @@ Failure model (what the pieces cover):
                                        restart (fit(elastic=...); kvstore
                                        membership epochs promote hangs to
                                        detected membership changes)
+  nobody watching the dashboards    -> controller.FleetController: the
+                                       policy loop closing telemetry to
+                                       actuation (evict blamed stragglers,
+                                       backfill, auto-tier compression,
+                                       goodput-per-chip world sizing) with
+                                       hysteresis, cooldowns, dry-run and
+                                       its own circuit breaker
   proving any of it works           -> chaos (seeded fault injection,
                                        tests only)
 """
@@ -25,7 +32,9 @@ Failure model (what the pieces cover):
 from .chaos import (Chaos, ChaosConfig, TransientError, TransientStepError,
                     chaos_scope)
 from . import chaos
+from . import controller
 from . import elastic
+from .controller import FleetController, FleetControllerConfig
 from .elastic import (ElasticCoordinator, MembershipChanged,
                       MembershipTimeout, ResizeEvent)
 from .guards import GuardConfig, StepTimeoutError, StepWatchdog
@@ -35,6 +44,7 @@ from .retry import CircuitBreaker, CircuitOpenError, RetryingKVStore, \
 
 __all__ = ["chaos", "Chaos", "ChaosConfig", "chaos_scope",
            "TransientError", "TransientStepError",
+           "controller", "FleetController", "FleetControllerConfig",
            "elastic", "ElasticCoordinator", "MembershipChanged",
            "MembershipTimeout", "ResizeEvent",
            "GuardConfig", "StepTimeoutError", "StepWatchdog",

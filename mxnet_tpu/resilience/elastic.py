@@ -144,6 +144,7 @@ class ElasticCoordinator:
         self._target = set(self._all)
         self._reasons: list = []
         self._beats: dict = {}
+        self._last_blamed = None  # newest straggler-detector blame
         self.membership_epoch = 0
         self.resizes = 0
         self._hb_thread = None
@@ -256,9 +257,26 @@ class ElasticCoordinator:
                 return joined
             joined.append(rank)
 
+    def record_blame(self, rank):
+        """Remember the rank the straggler detector most recently blamed
+        (the fleet controller calls this each policy tick). A shrink via
+        :meth:`request_world` prefers this rank as its victim — capacity
+        reductions should shed the slowest worker, not an arbitrary one."""
+        with self._lock:
+            self._last_blamed = None if rank is None else int(rank)
+
+    def last_heartbeat(self, rank):
+        """Monotonic time of ``rank``'s newest beat, or None (never
+        beat / departed). The controller's backfill policy uses this to
+        readmit a heartbeat-dead rank only once it is beating again."""
+        with self._lock:
+            return self._beats.get(int(rank))
+
     def request_world(self, n, reason="requested"):
-        """Explicit resize to ``n`` workers: shrink drops the highest
-        ranks, grow readmits the lowest departed ones."""
+        """Explicit resize to ``n`` workers: a shrink prefers the rank the
+        straggler detector most recently blamed (:meth:`record_blame`),
+        then drops the highest ranks; grow readmits the lowest departed
+        ones."""
         n = int(n)
         if not self.min_world <= n <= len(self._all):
             raise MXNetError(
@@ -269,7 +287,11 @@ class ElasticCoordinator:
                 cur = len(self._target)
                 # pick the victim under the lock: concurrent kill/join
                 # threads mutate the target set
-                victim = max(self._target) if cur > n else None
+                victim = None
+                if cur > n:
+                    blamed = self._last_blamed
+                    victim = blamed if blamed in self._target \
+                        else max(self._target)
             if cur == n:
                 return n
             if victim is not None:
@@ -379,6 +401,19 @@ class ElasticCoordinator:
                                ";".join(self._reasons) or kind,
                                self.membership_epoch + 1)
 
+    @staticmethod
+    def _reason_kinds(reason: str) -> str:
+        """Sorted, comma-joined categories behind one coalesced resize —
+        the trailing field of each ``kind:rank:why`` entry (``evicted``,
+        ``failure``, ``heartbeat``, ``chaos``, ``rejoin``, ...), so an
+        eviction the controller chose is distinguishable from a failure
+        the fleet suffered on every resize event and counter label."""
+        kinds = set()
+        for part in str(reason).split(";"):
+            bits = part.split(":", 2)
+            kinds.add(bits[2] if len(bits) == 3 else part)
+        return ",".join(sorted(k for k in kinds if k))
+
     def commit(self, event: ResizeEvent, logger=None):
         """Apply a polled resize: the target becomes the committed world,
         the membership epoch bumps, the hub world labels re-stamp, and a
@@ -402,10 +437,11 @@ class ElasticCoordinator:
         # exported metric family carries the new (virtual) world size
         telemetry.set_world(telemetry.current_rank(), len(event.ranks))
         telemetry.gauge("elastic_world_size", float(len(event.ranks)))
-        telemetry.counter("elastic_resizes_total")
+        reason_kinds = self._reason_kinds(event.reason)
+        telemetry.counter("elastic_resizes_total", reason=reason_kinds)
         telemetry.emit("resize", from_world=old, to_world=len(event.ranks),
-                       reason=event.reason, membership_epoch=epoch,
-                       resize_kind=event.kind)
+                       reason=event.reason, reason_kinds=reason_kinds,
+                       membership_epoch=epoch, resize_kind=event.kind)
         (logger or logging).info(
             "elastic: world resized %d -> %d (%s; membership epoch %d)",
             old, len(event.ranks), event.reason, epoch)

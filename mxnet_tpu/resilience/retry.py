@@ -29,6 +29,7 @@ the dist_async server) carry idempotency state keyed on (worker, seq).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import random
 import time
@@ -118,19 +119,67 @@ def retry_call(fn, policy: RetryPolicy, what="op", sleep=time.sleep,
 
 
 class CircuitBreaker:
-    """Consecutive-failure breaker with a half-open recovery probe."""
+    """Consecutive-failure breaker with a half-open recovery probe.
+
+    Every state transition (closed -> open, open -> half_open probe,
+    half_open -> closed/open) is observable (ISSUE 12 satellite: trips
+    used to be invisible to the flight recorder): a ``breaker`` event
+    lands in the hub ring + incident ring, and three labeled gauges track
+    the live state — ``circuit_breaker_state{breaker=}`` (0 closed,
+    1 half_open, 2 open), ``circuit_breaker_failures{breaker=}``
+    (consecutive failures), ``circuit_breaker_last_transition{breaker=}``
+    (hub-clock seconds of the newest transition). ``name`` labels the
+    series so the kvstore breaker and the fleet controller's breaker
+    stay distinguishable on one scrape."""
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+    _STATE_CODE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
 
     def __init__(self, failure_threshold=3, reset_after=5.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, name="kvstore"):
         self.failure_threshold = int(failure_threshold)
         self.reset_after = float(reset_after)
         self._clock = clock
+        self.name = str(name)
         self.state = self.CLOSED
         self._failures = 0
         self._opened_at = 0.0
         self.trip_count = 0
+        self.last_transition = None  # hub-clock ts of the newest change
+
+    @property
+    def failures(self) -> int:
+        """Consecutive failures since the last success."""
+        return self._failures
+
+    def publish_state(self):
+        """Publish the live-state gauges (also called on every
+        transition). Long-lived breakers — the fleet controller's —
+        call this from their owner's heartbeat so a scrape sees a
+        healthy CLOSED breaker, not an absent one."""
+        from .. import telemetry
+
+        telemetry.gauge("circuit_breaker_state",
+                        self._STATE_CODE[self.state], breaker=self.name)
+        telemetry.gauge("circuit_breaker_failures", float(self._failures),
+                        breaker=self.name)
+        if self.last_transition is not None:
+            telemetry.gauge("circuit_breaker_last_transition",
+                            self.last_transition, breaker=self.name)
+
+    def _transition(self, new_state):
+        """Move to ``new_state`` and publish it (no-op on a non-change).
+        Gauges + a ``breaker`` incident — the flight recorder's view of
+        why a store degraded or a controller froze."""
+        if new_state == self.state:
+            return
+        from .. import telemetry
+
+        old, self.state = self.state, new_state
+        self.last_transition = telemetry.hub().now()
+        self.publish_state()
+        telemetry.emit("breaker", breaker=self.name, state=new_state,
+                       from_state=old, failures=self._failures)
 
     def allow(self) -> bool:
         """May the caller attempt the real op right now?"""
@@ -138,16 +187,22 @@ class CircuitBreaker:
             return True
         if self.state == self.OPEN and \
                 self._clock() - self._opened_at >= self.reset_after:
-            self.state = self.HALF_OPEN  # one probe goes through
+            self._transition(self.HALF_OPEN)  # one probe goes through
             return True
         return self.state == self.HALF_OPEN
     # NOTE: single-threaded per worker handle (kvstore contract); no lock.
 
     def record_success(self):
         if self.state != self.CLOSED:
-            logging.info("circuit breaker: probe succeeded, closing")
-        self.state = self.CLOSED
+            logging.info("circuit breaker %s: probe succeeded, closing",
+                         self.name)
+        had_pressure = self._failures > 0
         self._failures = 0
+        self._transition(self.CLOSED)
+        if had_pressure and self.state == self.CLOSED:
+            # a below-threshold failure published a nonzero pressure
+            # gauge; the reset must clear it even without a transition
+            self.publish_state()
 
     def record_failure(self):
         self._failures += 1
@@ -156,16 +211,26 @@ class CircuitBreaker:
             if self.state != self.OPEN:
                 self.trip_count += 1
                 logging.warning(
-                    "circuit breaker: OPEN after %d consecutive failures "
-                    "(retry in %.1fs; degrading to local aggregation)",
+                    "circuit breaker %s: OPEN after %d consecutive "
+                    "failures (retry in %.1fs)", self.name,
                     self._failures, self.reset_after)
                 from .. import telemetry
 
                 telemetry.counter("resilience_circuit_open_total")
-                telemetry.emit("circuit_open", op="kvstore",
+                telemetry.emit("circuit_open", op=self.name,
                                failures=self._failures)
-            self.state = self.OPEN
             self._opened_at = self._clock()
+            self._transition(self.OPEN)
+        else:
+            from .. import telemetry
+
+            # failures below the threshold still move the gauge so a
+            # scrape sees pressure building before the trip
+            telemetry.gauge("circuit_breaker_failures",
+                            float(self._failures), breaker=self.name)
+
+
+_BREAKER_SEQ = itertools.count()  # unique default-breaker names per store
 
 
 class RetryingKVStore:
@@ -181,7 +246,11 @@ class RetryingKVStore:
                  breaker: CircuitBreaker = None):
         self._inner = inner
         self._policy = policy or RetryPolicy()
-        self._breaker = breaker or CircuitBreaker()
+        # per-instance breaker name: two stores' state gauges must not
+        # clobber each other on one scrape (a healthy store's success
+        # would overwrite a degraded store's OPEN reading)
+        self._breaker = breaker or CircuitBreaker(
+            name=f"kvstore{next(_BREAKER_SEQ)}")
         self._mirror: dict = {}        # key -> np.ndarray (last known value)
         self._fallback_updater = None  # applies pushes to the mirror offline
         self.stats = {"retries": 0, "degraded_ops": 0, "resyncs": 0}

@@ -50,6 +50,8 @@ from .flight import FlightRecorder, validate_flight
 from . import memory
 from .memory import (ArrayLedger, MemoryPreflightError, track_arrays,
                      plan_table, forensics_snapshot)
+from . import sensors
+from .sensors import StreamingStragglerDetector, comm_compute_ratio
 
 # the black box records from import on (and survives hub resets)
 flight.install()
@@ -73,6 +75,7 @@ __all__ = [
     "flight", "FlightRecorder", "validate_flight",
     "memory", "ArrayLedger", "MemoryPreflightError", "track_arrays",
     "plan_table", "forensics_snapshot",
+    "sensors", "StreamingStragglerDetector", "comm_compute_ratio",
     "counter", "gauge", "observe", "emit", "TelemetryConfig",
     "maybe_serve_http_from_env",
 ]

@@ -53,6 +53,12 @@ EVENT_GOLDEN_KEYS = {
     "lockwatch": ("what",),
     # elastic training (ISSUE 10)
     "resize": ("from_world", "to_world", "reason", "membership_epoch"),
+    # fleet controller (ISSUE 12): every policy decision is an event —
+    # inputs, lever, action, and what actually happened to it
+    "controller": ("lever", "action", "outcome"),
+    # circuit-breaker state transitions (ISSUE 12 satellite: trips used
+    # to be invisible to the flight recorder)
+    "breaker": ("breaker", "state", "from_state", "failures"),
     # memory observability (ISSUE 9)
     "memory_plan": ("program", "argument_bytes", "output_bytes",
                     "temp_bytes", "total_bytes"),

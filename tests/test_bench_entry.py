@@ -192,6 +192,39 @@ def test_bench_elastic_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_ELASTIC_*
 
 
+def test_bench_controller_smoke_json_contract():
+    """--controller-bench --smoke is the CI guard on the fleet-controller
+    bench entry (ISSUE 12): one JSON line with the contract keys, the
+    blamed straggler really evicted by the armed run, a compression tier
+    auto-picked, the breaker never tripped, and the armed fleet's
+    steady-state per-chip throughput recovering a positive fraction of
+    what the straggler cost the static fleet."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--controller-bench", "--smoke"],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "tpc_clean",
+                "tpc_static", "tpc_controller", "final_step_ms",
+                "evicted", "backfilled", "tier_chosen", "retier_actions",
+                "worlds", "breaker_state", "decisions_total"):
+        assert key in blob, blob
+    assert blob["metric"] == "controller_goodput_recovered_frac"
+    # the closed loop actually closed: blame -> evict -> recover
+    assert blob["evicted"] == [7]
+    assert blob["tier_chosen"] in ("bf16", "int8", "twobit")
+    assert blob["breaker_state"] == "closed"
+    # the straggler really cost the static fleet, and the armed fleet
+    # bought a solid share back (generous margin: shared-box timing)
+    assert blob["tpc_static"] < blob["tpc_clean"]
+    assert blob["value"] is not None and blob["value"] > 0.2, blob
+    assert blob["smoke"] is True  # smoke runs never write BENCH_CONTROLLER_*
+
+
 def test_bench_lockwatch_smoke_json_contract():
     """--lockwatch-bench --smoke is the CI guard on the lock-order
     watchdog bench (ISSUE 11): one JSON line with the contract keys,

@@ -1,0 +1,687 @@
+"""Fleet-controller tests (ISSUE 12).
+
+Covers: the pure policy functions (tier/overlap-cap selection, world
+choice), the streaming straggler detector's agreement contract with the
+batch detector (satellite), circuit-breaker observability (satellite),
+blame-preferring shrink victims + eviction-reason labels on resize
+events (satellite), the controller's safety rails (K-of-N hysteresis,
+cooldowns, rate limits, dry-run, quarantine, breaker freeze), and the
+e2e acceptance scenario: an armed dp-8 fit with an injected persistent
+straggler + a flaky rank — the controller evicts the blamed rank,
+backfills the recovered one, auto-picks a compression tier, survives
+its own actuation failures frozen-not-dead, and the whole story is in
+CRC-valid flight dumps. The chaos soak (kill/slow a random rank every
+N steps under the armed controller) is tier-2 (`slow`).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resilience import (CircuitBreaker, ElasticCoordinator,
+                                  FleetController)
+from mxnet_tpu.resilience.controller import (choose_world,
+                                             select_overlap_bytes,
+                                             select_tier)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    """Controller tests count events/gauges: isolate the hub, and keep
+    commit()'s world relabeling from leaking into later tests."""
+    prev = (telemetry.current_rank(), telemetry.world_size())
+    telemetry.reset()
+    yield
+    telemetry.set_world(*prev)
+    telemetry.reset()
+
+
+def _ctx(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+    return [mx.cpu(i) for i in range(n)]
+
+
+def _mlp(hidden=16, classes=2):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=hidden)
+    net = mx.sym.Activation(data=net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _blobs(n=840, dim=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.concatenate([rng.randn(n // 2, dim) + 1,
+                        rng.randn(n - n // 2, dim) - 1]).astype(np.float32)
+    y = np.concatenate([np.zeros(n // 2), np.ones(n - n // 2)]).astype(
+        np.float32)
+    order = rng.permutation(n)
+    return X[order], y[order]
+
+
+def _span(rank, step, device_ms, epoch=0, wire_ms=None):
+    phases = [{"name": "device", "dur_ms": device_ms}]
+    if wire_ms is not None:
+        phases.append({"name": "wire", "dur_ms": wire_ms})
+    return {"kind": "span", "name": "step", "epoch": epoch, "step": step,
+            "rank": rank, "dur_ms": device_ms + (wire_ms or 0.0),
+            "phases": phases}
+
+
+def _emit_fleet_step(step, world=8, slow_rank=None, slow_ms=30.0,
+                     base_ms=2.0, alive=None):
+    for r in (alive if alive is not None else range(world)):
+        d = slow_ms if r == slow_rank else base_ms
+        telemetry.emit("span", rank=r, name="step", epoch=0, step=step,
+                       dur_ms=d, phases=[{"name": "device", "dur_ms": d}])
+
+
+def _controller(co=None, **kw):
+    defaults = dict(interval=0.0, window=8, min_report_steps=8,
+                    evict_k=2, evict_n=3, rejoin_after=1000.0,
+                    evaluate_after=1000.0,
+                    cooldowns={"evict": 0.0, "backfill": 0.0,
+                               "retier": 0.0, "world": 0.0})
+    defaults.update(kw)
+    ctl = FleetController(**defaults)
+    if co is not None:
+        ctl.bind(coordinator=co, model_key="m", world_size=co.world_size,
+                 can_retier=True, fp32_wire_bytes=1e6)
+    return ctl
+
+
+# -- pure policy ---------------------------------------------------------------
+
+def test_select_tier_thresholds():
+    assert select_tier(None) is None
+    assert select_tier(0.0) == "none"
+    assert select_tier(0.05) == "none"
+    assert select_tier(0.2) == "bf16"
+    assert select_tier(0.8) == "int8"
+    assert select_tier(3.0) == "twobit"
+
+
+def test_select_overlap_bytes_monotone():
+    assert select_overlap_bytes(None) is None
+    assert select_overlap_bytes(0.05) is None  # wire negligible
+    caps = [select_overlap_bytes(r) for r in (0.2, 0.4, 0.8, 2.0)]
+    assert all(c >= (1 << 20) for c in caps)
+    # more comm-bound -> buckets no larger (wire starts earlier)
+    assert all(a >= b for a, b in zip(caps, caps[1:]))
+
+
+def test_choose_world_needs_margin_and_measurement():
+    # unmeasured current world: never move blind
+    assert choose_world({6: 2.0}, 8, 2, 8) == 8
+    # measured better world past the margin: move
+    assert choose_world({8: 1.0, 6: 1.5}, 8, 2, 8) == 6
+    # inside the margin: hysteresis holds
+    assert choose_world({8: 1.0, 6: 1.05}, 8, 2, 8, margin=0.1) == 8
+    # outside [lo, hi]: not a candidate
+    assert choose_world({8: 1.0, 2: 9.0}, 8, 4, 8) == 8
+
+
+# -- streaming straggler detector (satellite) ----------------------------------
+
+def test_streaming_detector_agrees_with_batch():
+    """The contract: report() == detect_stragglers on the same window."""
+    det = telemetry.StreamingStragglerDetector(window=16)
+    events = {r: [] for r in range(4)}
+    for step in range(16):
+        for r in range(4):
+            e = _span(r, step, 25.0 if r == 2 else 5.0)
+            events[r].append(e)
+            det.write_event(e)
+    batch = telemetry.detect_stragglers(events, window=16, publish=False)
+    streaming = det.report(publish=False)
+    assert streaming == batch
+    assert [s["rank"] for s in streaming["stragglers"]] == [2]
+
+
+def test_streaming_detector_windows_incrementally():
+    """Only the trailing `window` fleet steps are retained/judged — the
+    point of the sensor: report cost is bounded by the window, never by
+    run length, and old-world history ages out."""
+    det = telemetry.StreamingStragglerDetector(window=8)
+    # 30 early steps where rank 0 is slow...
+    for step in range(30):
+        for r in range(3):
+            det.write_event(_span(r, step, 25.0 if r == 0 else 5.0))
+    # ...then 8 healthy steps: the window forgets the old blame
+    for step in range(30, 38):
+        for r in range(3):
+            det.write_event(_span(r, step, 5.0))
+    snap = det.snapshot()
+    keys = sorted({(e["epoch"], e["step"]) for evs in snap.values()
+                   for e in evs})
+    assert len(keys) == 8 and keys[0] == (0, 30)
+    report = det.report(publish=False)
+    assert report["stragglers"] == []
+    assert report == telemetry.detect_stragglers(snap, window=8,
+                                                 publish=False)
+
+
+def test_streaming_detector_is_a_hub_sink():
+    det = telemetry.StreamingStragglerDetector(window=4).attach()
+    try:
+        _emit_fleet_step(0, world=2)
+        assert det.steps_seen == 2
+        telemetry.emit("retry", op="x", attempt=0)  # filtered out
+        assert det.steps_seen == 2
+    finally:
+        det.detach()
+
+
+# -- circuit-breaker observability (satellite) ---------------------------------
+
+def test_breaker_transitions_are_observable():
+    clock = [0.0]
+    br = CircuitBreaker(failure_threshold=2, reset_after=5.0,
+                        clock=lambda: clock[0], name="testbrk")
+    br.record_failure()
+    assert br.state == br.CLOSED and br.failures == 1
+    br.record_failure()             # trip: closed -> open
+    assert br.state == br.OPEN and br.last_transition is not None
+    clock[0] = 6.0
+    assert br.allow()               # open -> half_open probe
+    assert br.state == br.HALF_OPEN
+    br.record_success()             # half_open -> closed
+    assert br.state == br.CLOSED and br.failures == 0
+
+    events = telemetry.hub().events(kind="breaker")
+    transitions = [(e["from_state"], e["state"]) for e in events
+                   if e["breaker"] == "testbrk"]
+    assert transitions == [("closed", "open"), ("open", "half_open"),
+                           ("half_open", "closed")]
+    for e in events:
+        for key in telemetry.EVENT_GOLDEN_KEYS["breaker"]:
+            assert key in e, (key, e)
+    snap = telemetry.hub().snapshot()["gauges"]
+    assert snap["circuit_breaker_state{breaker=testbrk}"] == 0.0
+    assert snap["circuit_breaker_failures{breaker=testbrk}"] == 0.0
+    assert snap["circuit_breaker_last_transition{breaker=testbrk}"] > 0
+
+
+def test_breaker_incidents_reach_flight_recorder():
+    telemetry.flight.reset()
+    br = CircuitBreaker(failure_threshold=1, name="flightbrk")
+    br.record_failure()
+    _, _, incidents = telemetry.flight.recorder().snapshot()
+    kinds = {e["kind"] for e in incidents}
+    assert "breaker" in kinds and "circuit_open" in kinds
+
+
+# -- blame-preferring shrink victims (satellite) -------------------------------
+
+def test_request_world_prefers_blamed_rank():
+    co = ElasticCoordinator(8)
+    co.record_blame(3)
+    co.request_world(7, reason="goodput")
+    assert co.poll().ranks == (0, 1, 2, 4, 5, 6, 7)  # 3, not 7, left
+    co.commit(co.poll())
+    # blame gone (or departed): falls back to the highest rank
+    co.record_blame(None)
+    co.request_world(6)
+    assert co.poll().ranks == (0, 1, 2, 4, 5, 6)
+
+
+def test_resize_event_carries_eviction_reason_kinds():
+    co = ElasticCoordinator(4)
+    co.kill(3, reason="evicted")
+    co.commit(co.poll())
+    co.kill(2, reason="failure")
+    co.commit(co.poll())
+    resizes = telemetry.hub().events(kind="resize")
+    assert resizes[0]["reason_kinds"] == "evicted"
+    assert resizes[1]["reason_kinds"] == "failure"
+    counters = telemetry.hub().snapshot()["counters"]
+    assert counters["elastic_resizes_total{reason=evicted}"] == 1.0
+    assert counters["elastic_resizes_total{reason=failure}"] == 1.0
+
+
+# -- controller safety rails ---------------------------------------------------
+
+def test_hysteresis_one_off_spike_never_evicts():
+    co = ElasticCoordinator(8)
+    ctl = _controller(co, evict_k=3, evict_n=5)
+    # one window blames rank 7, then the fleet is healthy again
+    for s in range(8):
+        _emit_fleet_step(s, slow_rank=7)
+    ctl.tick(now=1.0)
+    for s in range(8, 24):
+        _emit_fleet_step(s)
+    for i in range(4):
+        ctl.tick(now=2.0 + i)
+    assert co.poll() is None            # nobody evicted
+    assert not [d for d in ctl.decisions if d["outcome"] == "actuated"]
+
+    # persistent blame crosses K-of-N: evicted
+    for s in range(24, 48):
+        _emit_fleet_step(s, slow_rank=7)
+        ctl.tick(now=10.0 + s)
+    ev = co.poll()
+    assert ev is not None and 7 not in ev.ranks
+    acts = [d for d in ctl.decisions if d["outcome"] == "actuated"]
+    assert [d["lever"] for d in acts] == ["evict"]
+    assert acts[0]["rank"] == 7 and acts[0]["blame"] == "device"
+
+
+def test_dry_run_recommends_but_never_actuates():
+    co = ElasticCoordinator(8)
+    ctl = _controller(co, dry_run=True, wire_gbps=1e-6)  # comm-bound too
+    assert ctl.state == "dry_run"
+    for s in range(32):
+        _emit_fleet_step(s, slow_rank=5)
+        ctl.tick(now=float(s))
+    assert co.poll() is None                      # nothing actuated
+    assert ctl.take_retier() is None
+    outcomes = {d["outcome"] for d in ctl.decisions}
+    assert outcomes == {"recommended"}
+    levers = {d["lever"] for d in ctl.decisions}
+    assert "evict" in levers and "retier" in levers
+
+
+def test_cooldown_and_rate_limit():
+    co = ElasticCoordinator(8, min_world=2)
+    ctl = _controller(co, cooldowns={"evict": 1000.0}, evict_k=1,
+                      evict_n=1)
+    for s in range(8):
+        _emit_fleet_step(s, slow_rank=7)
+    ctl.tick(now=100.0)
+    co.commit(co.poll())                           # 7 evicted, committed
+    for s in range(8, 24):
+        _emit_fleet_step(s, slow_rank=6, alive=range(7))
+        ctl.tick(now=101.0 + s)                    # inside the cooldown
+    assert co.poll() is None
+    assert any(d["outcome"] == "cooldown" for d in ctl.decisions)
+
+    # rate limiter: cooldown passed but the hourly budget is spent
+    ctl2 = _controller(ElasticCoordinator(8), evict_k=1, evict_n=1,
+                       max_actions_per_hour=0)
+    for s in range(8):
+        _emit_fleet_step(s, slow_rank=7)
+    ctl2.tick(now=1.0)
+    assert ctl2._co.poll() is None
+    assert any(d["outcome"] == "rate_limited" for d in ctl2.decisions)
+
+
+def test_quarantine_after_max_evictions():
+    co = ElasticCoordinator(8)
+    ctl = _controller(co, evict_k=1, evict_n=1, max_evictions=1,
+                      rejoin_after=0.0)
+    for s in range(8):
+        _emit_fleet_step(s, slow_rank=7)
+    ctl.tick(now=1.0)
+    co.commit(co.poll())                          # eviction committed
+    # probation lapsed, but one eviction == quarantine: never readmitted
+    for s in range(8, 16):
+        _emit_fleet_step(s, alive=range(7))
+        ctl.tick(now=10.0 + s)
+    assert co.poll() is None
+    assert 7 not in co.alive
+
+
+def test_backfill_rejoins_after_probation():
+    co = ElasticCoordinator(8)          # no heartbeat discipline
+    ctl = _controller(co, max_evictions=5, rejoin_after=0.0, evict_k=1,
+                      evict_n=1)
+    co.kill(4, reason="failure")        # the fleet lost a rank on its own
+    co.commit(co.poll())
+    for s in range(8):
+        _emit_fleet_step(s, alive=[r for r in range(8) if r != 4])
+    ctl.tick(now=50.0)
+    ev = co.poll()
+    assert ev is not None and 4 in ev.ranks       # backfilled
+    acts = [d for d in ctl.decisions if d["outcome"] == "actuated"]
+    assert acts and acts[-1]["lever"] == "backfill"
+
+
+def test_backfill_gate_disables_the_lever():
+    """auto_backfill=False: an operator-drained rank is never force-
+    rejoined (every lever is independently gated)."""
+    co = ElasticCoordinator(8)
+    ctl = _controller(co, auto_backfill=False, rejoin_after=0.0)
+    co.leave(4, reason="maintenance")
+    co.commit(co.poll())
+    for s in range(8):
+        _emit_fleet_step(s, alive=[r for r in range(8) if r != 4])
+    ctl.tick(now=50.0)
+    assert co.poll() is None
+    assert not [d for d in ctl.decisions if d["lever"] == "backfill"]
+
+
+def test_backfill_waits_for_fresh_heartbeat():
+    co = ElasticCoordinator(8, heartbeat_timeout=0.2)
+    ctl = _controller(co, rejoin_after=0.0)
+    for r in range(8):
+        co.heartbeat(r)
+    co.kill(4, reason="failure")
+    co.commit(co.poll())
+    for s in range(8):
+        _emit_fleet_step(s, alive=[r for r in range(8) if r != 4])
+    ctl.tick(now=50.0)
+    assert co.poll() is None            # dead-silent: stays out
+    co.heartbeat(4)                     # it beats again -> readmit
+    ctl.tick(now=51.0)
+    ev = co.poll()
+    assert ev is not None and 4 in ev.ranks
+
+
+def test_breaker_freezes_controller_on_failed_actuations():
+    co = ElasticCoordinator(8)
+    ctl = _controller(co, evict_k=1, evict_n=1)
+
+    fails = {"n": 0}
+    real_kill = co.kill
+
+    def broken_kill(rank=None, reason="failure"):
+        fails["n"] += 1
+        raise RuntimeError("kvstore wedged")
+
+    co.kill = broken_kill
+    try:
+        for s in range(40):
+            _emit_fleet_step(s, slow_rank=7)
+            ctl.tick(now=float(s))
+    finally:
+        co.kill = real_kill
+    # controller breaker: 2 consecutive failures -> open -> frozen
+    assert fails["n"] == 2
+    assert ctl.breaker.state == CircuitBreaker.OPEN
+    assert ctl.state == "frozen"
+    outcomes = [d["outcome"] for d in ctl.decisions
+                if d["lever"] == "evict"]
+    assert outcomes.count("failed") == 2
+    assert "frozen" in outcomes
+    assert co.poll() is None            # nothing ever actuated
+    snap = telemetry.hub().snapshot()["gauges"]
+    assert snap["controller_state"] == 2.0  # frozen
+    assert snap["circuit_breaker_state{breaker=controller}"] == 2.0
+
+
+def test_goodput_regression_counts_against_breaker():
+    co = ElasticCoordinator(8)
+    ctl = _controller(co, evict_k=1, evict_n=1, evaluate_after=5.0,
+                      regress_tolerance=0.1)
+    for s in range(8):
+        _emit_fleet_step(s, slow_rank=7)
+    ctl.tick(now=1.0)                   # evicts rank 7, baseline banked
+    co.commit(co.poll())
+    # post-actuation fleet is MUCH slower -> evaluation records a failure
+    for s in range(8, 24):
+        _emit_fleet_step(s, base_ms=50.0, alive=range(7))
+    ctl.tick(now=10.0)                  # past the evaluate_after deadline
+    assert ctl.breaker.failures >= 1
+    assert any(d["outcome"] == "regressed" for d in ctl.decisions)
+
+
+def test_tick_thread_mode_runs_and_stops():
+    co = ElasticCoordinator(8)
+    ctl = _controller(co, interval=0.01)
+    t = ctl.start()
+    assert t.name == "mx-fleet-ctl" and t.daemon
+    assert ctl.threaded
+    for s in range(8):
+        _emit_fleet_step(s)
+    time.sleep(0.1)
+    ctl.stop()
+    assert not ctl.threaded
+    # the thread ticked: state gauge was published
+    assert telemetry.hub().snapshot()["gauges"]["controller_state"] == 0.0
+
+
+def test_controller_resolve():
+    ctl = FleetController()
+    assert FleetController.resolve(ctl) is ctl
+    assert FleetController.resolve(None) is None
+    assert FleetController.resolve(False) is None
+    assert FleetController.resolve(True).cfg.dry_run is False
+    os.environ["MXNET_TPU_CONTROLLER"] = "dry"
+    try:
+        assert FleetController.resolve(None).cfg.dry_run is True
+    finally:
+        del os.environ["MXNET_TPU_CONTROLLER"]
+    with pytest.raises(MXNetError):
+        FleetController.resolve("bogus")
+
+
+# -- e2e: the acceptance scenario ----------------------------------------------
+
+class _FleetFaults:
+    """Injected pathology for a dp-8 virtual fit: rank 7 drags every
+    step (a real sleep — the whole SPMD step waits on it) and emits
+    per-rank spans blaming it; rank 6's out-of-band heartbeats stop
+    mid-run until the coordinator buries it, then resume (the host
+    "recovered" — recovery precedes readmission). The beater thread
+    heartbeats every rank, departed ones included, so a long AOT
+    re-warm gap can never read as a mass death."""
+
+    def __init__(self, co, stall_s=0.015, straggler=7, flaky=6,
+                 outage_step=8):
+        self.co = co
+        self.stall_s = stall_s
+        self.straggler = straggler
+        self.flaky = flaky
+        self.outage_step = outage_step
+        self.step = 0
+        self._outage = False
+        self._recovered = False
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._beat, daemon=True)
+        self.thread.start()
+
+    def _beat(self):
+        while not self._stop.wait(0.02):
+            if self._outage and not self._recovered and \
+                    self.co.last_heartbeat(self.flaky) is None:
+                # the coordinator buried it (kill pops the beat record):
+                # the flaky host comes back and starts beating again
+                self._recovered = True
+            silent = self._outage and not self._recovered
+            for r in range(self.co.full_world_size):
+                if r == self.flaky and silent:
+                    continue
+                self.co.heartbeat(r)
+
+    def close(self):
+        self._stop.set()
+        self.thread.join(timeout=2.0)
+
+    def __call__(self, param):
+        del param
+        s = self.step
+        self.step += 1
+        if s >= self.outage_step:
+            self._outage = True
+        alive = self.co.alive
+        if self.straggler in alive:
+            time.sleep(self.stall_s)
+        for r in alive:
+            d = (self.stall_s * 1e3 + 2.0) if r == self.straggler else 2.0
+            telemetry.emit("span", rank=r, name="step", epoch=0, step=s,
+                           dur_ms=d,
+                           phases=[{"name": "device", "dur_ms": d}])
+
+
+def test_e2e_controller_evicts_backfills_and_retiers(tmp_path):
+    """ISSUE 12 acceptance: persistent straggler + flaky rank in a dp-8
+    fit; the armed controller evicts the blamed rank, backfills the
+    recovered flaky rank, auto-picks a compression tier from the
+    (bandwidth-scaled) comm:compute ratio, and the whole run lands in a
+    CRC-valid flight dump with controller incidents."""
+    X, y = _blobs(n=840)
+    batch = 168                       # divisible by every world 6/7/8
+    co = ElasticCoordinator(8, heartbeat_timeout=0.3)
+    ctl = FleetController(
+        interval=0.0, window=16, min_report_steps=16, evict_k=2,
+        evict_n=4, max_evictions=1, rejoin_after=0.05,
+        evaluate_after=0.5,
+        cooldowns={"evict": 0.0, "backfill": 0.0, "retier": 0.0},
+        wire_gbps=1e-5)               # scaled: the tier policy must act
+    # outage from step 2: the flaky rank must die, recover, and be
+    # backfilled with plenty of run left (the eviction/retier re-warm
+    # gaps push most steps late)
+    faults = _FleetFaults(co, outage_step=2)
+    m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=6, optimizer="sgd",
+                       learning_rate=0.1)
+    try:
+        m.fit(X, y, batch_size=batch, elastic=co, controller=ctl,
+              sharded_checkpoint_dir=str(tmp_path / "ckpt"),
+              batch_end_callback=faults,
+              telemetry=telemetry.TelemetryConfig(timeline=False,
+                                                  memory=False))
+    finally:
+        faults.close()
+
+    # the blamed straggler was evicted (reason label distinguishes it
+    # from a failure), training converged on the survivors
+    evicts = [d for d in ctl.decisions
+              if d["lever"] == "evict" and d["outcome"] == "actuated"]
+    assert [d["rank"] for d in evicts] == [7]
+    assert 7 not in co.alive
+    resize_events = telemetry.hub().events(kind="resize")
+    assert any("evicted" in e.get("reason_kinds", "")
+               for e in resize_events)
+    # the flaky rank died by heartbeat and was backfilled once it beat
+    # again (a loaded box can expire other ranks too — the contract is
+    # that rank 6 came back, not that nothing else ever flapped)
+    backfills = [d for d in ctl.decisions
+                 if d["lever"] == "backfill" and
+                 d["outcome"] == "actuated"]
+    assert 6 in [d["rank"] for d in backfills]
+    assert 6 in co.alive
+    # the tier policy actually picked a tier on this (scaled) rig
+    assert ctl._comm_mode in ("bf16", "int8", "twobit")
+    assert any(d["lever"] == "retier" and d["outcome"] == "actuated"
+               for d in ctl.decisions)
+    assert ctl.breaker.state == CircuitBreaker.CLOSED
+    assert m.score(X, y=y) > 0.9
+
+    # forensics: the decision log is in a CRC-valid flight dump
+    dump = str(tmp_path / "flight.json")
+    telemetry.flight.dump(dump, reason="test")
+    ok, payload = telemetry.validate_flight(dump)
+    assert ok, payload
+    kinds = {e["kind"] for e in payload["incidents"]}
+    assert "controller" in kinds
+
+
+def test_e2e_controller_failure_freezes_not_kills(tmp_path):
+    """A controller whose staged actuation cannot be applied (bogus
+    tier) trips its own breaker and freezes — the fit finishes
+    unharmed."""
+    X, y = _blobs(n=480)
+    co = ElasticCoordinator(8)
+    ctl = FleetController(interval=0.0, window=8, min_report_steps=8,
+                          auto_tier=False, auto_evict=False)
+    staged = {"n": 0}
+
+    def drive(param):
+        telemetry.emit("span", rank=0, name="step", epoch=0,
+                       step=staged.setdefault("s", 0), dur_ms=2.0,
+                       phases=[{"name": "device", "dur_ms": 2.0}])
+        staged["s"] = staged.get("s", 0) + 1
+        if staged["n"] < 2:
+            staged["n"] += 1
+            # sabotage: stage an unappliable tier change
+            ctl._pending_retier = {"mode": "bogus-tier"}
+
+    m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=2, optimizer="sgd",
+                       learning_rate=0.1)
+    m.fit(X, y, batch_size=48, elastic=co, controller=ctl,
+          sharded_checkpoint_dir=str(tmp_path / "ckpt"),
+          batch_end_callback=drive)
+    assert staged["n"] == 2
+    assert ctl.breaker.state == CircuitBreaker.OPEN
+    assert ctl.state == "frozen"
+    fails = [d for d in ctl.decisions if d["outcome"] == "failed"]
+    assert len(fails) == 2 and all(d["lever"] == "retier" for d in fails)
+    assert m.score(X, y=y) > 0.9      # the fit itself never noticed
+
+
+# -- tier-2 chaos soak ---------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_soak_controller_keeps_fleet_healthy(tmp_path):
+    """Tier-2 soak (satellite): a random rank is killed or slowed every
+    few steps for several minutes of virtual training under the armed
+    controller — the run must never hang, the fleet must converge, and
+    every flight dump must validate."""
+    flight_dir = str(tmp_path / "flight")
+    os.makedirs(flight_dir)
+    prev_flight = os.environ.get("MXNET_TPU_FLIGHT_DIR")
+    os.environ["MXNET_TPU_FLIGHT_DIR"] = flight_dir
+    try:
+        X, y = _blobs(n=1680)
+        batch = 168                   # divides every reachable world 6/7/8
+        co = ElasticCoordinator(8, min_world=6)
+        ctl = FleetController(
+            interval=0.0, window=16, min_report_steps=16, evict_k=2,
+            evict_n=4, max_evictions=3, rejoin_after=0.1,
+            evaluate_after=0.5,
+            cooldowns={"evict": 0.2, "backfill": 0.1, "retier": 1.0})
+        rng = np.random.RandomState(7)
+        state = {"s": 0, "slow": None}
+        kill_every, rejoin_every = 9, 23
+
+        def drive(param):
+            s = state["s"]
+            state["s"] += 1
+            if s % 5 == 0:            # re-roll the slowed rank
+                alive = co.alive
+                state["slow"] = int(rng.choice(alive)) \
+                    if rng.rand() < 0.7 else None
+            # random-rank churn, floor-safe: a kill only lands while the
+            # TARGET world has headroom (MX311-exempt: tests own chaos)
+            if s and s % kill_every == 0:
+                ev = co.poll()
+                headroom = (ev.world_size if ev is not None
+                            else co.world_size) > co.min_world
+                if headroom:
+                    co.kill(reason="failure")
+            if s and s % rejoin_every == 0:
+                co.join_all(reason="recovered")
+            slow = state["slow"]
+            alive = co.alive
+            if slow in alive:
+                time.sleep(0.005)
+            for r in alive:
+                d = 7.0 if r == slow else 2.0
+                telemetry.emit(
+                    "span", rank=r, name="step", epoch=0, step=s,
+                    dur_ms=d, phases=[{"name": "device", "dur_ms": d}])
+
+        m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=8,
+                           optimizer="sgd", learning_rate=0.1)
+        it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False)
+        m.fit(it, batch_size=batch, elastic=co, controller=ctl,
+              sharded_checkpoint_dir=str(tmp_path / "ckpt"),
+              batch_end_callback=drive)
+
+        assert co.resizes >= 3            # the soak really churned
+        assert co.world_size >= co.min_world
+        assert m.score(X, y=y) > 0.9      # converged through it all
+        assert ctl.decisions              # the controller was alive
+        # every dump written during the soak + one final validates
+        final = os.path.join(flight_dir, "final.json")
+        telemetry.flight.dump(final, reason="soak_end")
+        dumps = [os.path.join(flight_dir, f)
+                 for f in os.listdir(flight_dir)]
+        assert dumps
+        for path in dumps:
+            ok, payload = telemetry.validate_flight(path)
+            assert ok, (path, payload)
+    finally:
+        if prev_flight is None:
+            os.environ.pop("MXNET_TPU_FLIGHT_DIR", None)
+        else:
+            os.environ["MXNET_TPU_FLIGHT_DIR"] = prev_flight

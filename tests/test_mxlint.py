@@ -549,6 +549,70 @@ def test_tree_has_no_mx310_findings():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+# -- MX311 fleet-actuation-outside-the-policy-loop fixtures (ISSUE 12) ---------
+
+def test_fixture_mx311_direct_actuation():
+    src = (
+        "def rebalance(co, kv):\n"
+        "    co.kill(3, reason='slow')\n"
+        "    co.request_world(4)\n"
+        "    kv.set_gradient_compression('int8')\n"
+    )
+    findings = lint_source(src, "mxnet_tpu/somewhere.py")
+    assert _ids(findings) == ["MX311", "MX311", "MX311"]
+    assert [f.line for f in findings] == [2, 3, 4]
+    # coordinator-shaped receiver names all count for .kill
+    src2 = (
+        "def f(elastic_co, my_coordinator):\n"
+        "    elastic_co.kill()\n"
+        "    my_coordinator.kill(1)\n"
+    )
+    assert _ids(lint_source(src2, "mxnet_tpu/x.py")) == ["MX311", "MX311"]
+
+
+def test_fixture_mx311_non_actuation_kills_clean():
+    # os.kill / process handles are not fleet actuation; an override
+    # delegating to its base class is a definition, not a site
+    src = (
+        "import os\n"
+        "def f(proc):\n"
+        "    os.kill(123, 9)\n"
+        "    proc.kill()\n"
+        "class S(Base):\n"
+        "    def set_gradient_compression(self, c):\n"
+        "        return super().set_gradient_compression(c)\n"
+    )
+    assert _ids(lint_source(src, "mxnet_tpu/x.py")) == []
+
+
+def test_fixture_mx311_exemptions_and_pragma():
+    src = "def f(co):\n    co.request_world(4)\n"
+    # the policy loop and the lever's owner are the sanctioned homes
+    assert _ids(lint_source(
+        src, "mxnet_tpu/resilience/controller.py")) == []
+    assert _ids(lint_source(src, "mxnet_tpu/resilience/elastic.py")) == []
+    # tests and examples drive fleets by hand
+    assert _ids(lint_source(src, "tests/test_x.py")) == []
+    assert _ids(lint_source(src, "examples/distributed/demo.py")) == []
+    # deliberate out-of-loop sites carry the audit-record pragma
+    src_pr = ("def f(co):\n"
+              "    co.request_world(4)  "
+              "# mxlint: disable=MX311 - recovery runbook tool\n")
+    assert _ids(lint_source(src_pr, "mxnet_tpu/x.py")) == []
+
+
+def test_tree_has_no_mx311_findings():
+    """ISSUE 12 satellite: fleet actuation in the tree flows through the
+    FleetController policy loop — the two launch-config sites
+    (fit/create_group applying a user's static compression spec) carry
+    justified pragmas."""
+    from mxnet_tpu.analysis import lint_paths
+
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX311"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 # -- MX307 leaked-span fixtures (ISSUE 6 satellite) ----------------------------
 
 def test_fixture_mx307_leaked_span():

@@ -150,6 +150,11 @@ def test_jsonl_schema_golden_keys(tmp_path):
     # concurrency watchdog kind (ISSUE 11)
     h.emit("lockwatch", what="cycle", cycle="a->b", closing_edge="b->a",
            thread="mx-kv-serve-1")
+    # fleet-controller kinds (ISSUE 12)
+    h.emit("controller", lever="evict", action="evict rank 7",
+           outcome="actuated", rank=7, votes=3, dry_run=False)
+    h.emit("breaker", breaker="controller", state="open",
+           from_state="closed", failures=2)
     path = str(tmp_path / "events.jsonl")
     telemetry.write_jsonl(path, h.events())
     rows = telemetry.read_jsonl(path)
