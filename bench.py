@@ -1636,6 +1636,212 @@ def run_controller_bench(args):
         print(f"wrote {out}", file=sys.stderr)
 
 
+def run_kernel_bench(args):
+    """--kernel-bench: the Pallas kernel layer's roofline accounting
+    (ISSUE 13). Three measurements, one JSON line (full runs write
+    BENCH_KERNELS_r16.json):
+
+    (a) a roofline row per registered kernel — registry FLOP/byte model
+        vs measured interpret-mode wall time on this rig (CPU numbers:
+        the interpreter prices correctness, not Mosaic speed; the row
+        SCHEMA is the TPU contract, and flash's on-chip numbers live in
+        FLASH_r05.json / the kernel catalog);
+    (b) the fused-vs-unfused HLO delta on the dp-8 compressed allreduce:
+        full-slab quantize-shaped elementwise passes (the encode/decode
+        cost the comm kernels remove) and collective wire bytes (which
+        must NOT change — same bits on the wire);
+    (c) the fused-Adam step-time delta vs the per-leaf optimizer tree,
+        parity-checked bitwise on the same inputs.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import mxnet_tpu.optimizer as opt_mod
+    from mxnet_tpu import comm
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.analysis import jaxpr_audit
+    from mxnet_tpu.compat import shard_map
+    from mxnet_tpu.ops import pallas as pk
+    from mxnet_tpu.telemetry.mfu import measured_peak_flops
+
+    smoke = args.smoke
+    rng = np.random.RandomState(0)
+    peak = measured_peak_flops()
+
+    def time_fn(fn, *a, iters=None, warmup=2):
+        iters = iters or (3 if smoke else 20)
+        for _ in range(warmup):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        return (_time.perf_counter() - t0) / iters
+
+    def roofline_row(label, fn, *a):
+        """One kernel invocation: registry-priced cost + measured time."""
+        jitted = jax.jit(fn)
+        rows, totals = jaxpr_audit.cost_rows(fn, *a)
+        krows = [r for r in rows if r["primitive"].startswith("pallas::")]
+        flops = sum(r["flops"] for r in krows)
+        bytes_ = sum(r["bytes"] for r in krows)
+        dt = time_fn(jitted, *a)
+        return {
+            "kernel": label,
+            "kernels_in_program": [r["primitive"] for r in krows],
+            "model_flops": flops,
+            "model_bytes": bytes_,
+            "intensity_flops_per_byte": round(flops / bytes_, 3)
+            if bytes_ else None,
+            "ms": round(dt * 1e3, 4),
+            "achieved_gflops_s": round(flops / dt / 1e9, 3),
+            "achieved_gbytes_s": round(bytes_ / dt / 1e9, 3),
+            "pct_of_measured_peak": round(100.0 * flops / dt / peak, 3),
+        }
+
+    # -- (a) per-kernel roofline rows (interpret mode on this rig) ---------
+    b, h, s, d = (1, 2, 128, 32) if smoke else (2, 4, 512, 64)
+    q = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+    slab_r, slab_l = (8, 4096) if smoke else (8, 65536)
+    rows_in = jnp.asarray(rng.randn(slab_r, slab_l).astype(np.float32))
+    spec8 = comm.CompressionSpec("int8", chunk=256)
+    spec2 = comm.CompressionSpec("twobit", threshold=0.5, chunk=256)
+    m_mm, k_mm, n_mm = (64, 128, 64) if smoke else (512, 1024, 512)
+    x_mm = jnp.asarray(rng.randn(m_mm, k_mm).astype(np.float32))
+    w_mm = jnp.asarray(rng.randn(n_mm, k_mm).astype(np.float32))
+
+    names = ["p0", "p1", "p2"]
+    shapes = [(256, 64), (64,), (64, 32)] if smoke else \
+        [(1024, 512), (512,), (512, 256)]
+    params = {n: jnp.asarray(rng.randn(*sh).astype(np.float32))
+              for n, sh in zip(names, shapes)}
+    grads = {n: jnp.asarray(rng.randn(*sh).astype(np.float32))
+             for n, sh in zip(names, shapes)}
+    adam_f = opt_mod.Adam(lr=1e-3, fused=True)
+    adam_u = opt_mod.Adam(lr=1e-3, fused=False)
+    states = adam_f.init_state_tree(params)
+    lr = jnp.float32(1e-3)
+
+    kernels = [
+        roofline_row("flash_attention_fwd",
+                     lambda x: pk.flash_attention(x, x, x, causal=True), q),
+        roofline_row(
+            "flash_attention_fwd_bwd",
+            lambda x: jax.grad(lambda y: jnp.sum(
+                pk.flash_attention(y, y, y, causal=True)))(x), q),
+        roofline_row(
+            "quant_int8",
+            lambda r: pk.fused_quantize(spec8, r, want_dequant=True)[0]["q"],
+            rows_in),
+        roofline_row(
+            "quant_twobit",
+            lambda r: pk.fused_quantize(spec2, r, want_dequant=True)[0]["q"],
+            rows_in),
+        # payload built OUTSIDE the measured fn: the row prices the
+        # dequant-sum kernel alone, not a quantize+dequant pair
+        roofline_row(
+            "dequant_sum_int8",
+            lambda p: pk.fused_dequant_sum(spec8, p),
+            jax.jit(lambda r: pk.fused_quantize(spec8, r)[0])(rows_in)),
+        roofline_row("fused_adam",
+                     lambda p, g, st: pk.fused_adam_apply(
+                         adam_f, p, g, st, lr)[0]["p0"],
+                     params, grads, states),
+        roofline_row("int8_matmul",
+                     lambda a, w: pk.int8_matmul(a, w), x_mm, w_mm),
+    ]
+
+    # -- (b) fused-vs-unfused HLO delta on the dp-8 exchange ---------------
+    ndev = 8
+    mesh = par.make_mesh(dp=ndev, devices=jax.devices()[:ndev])
+    L = ndev * (2048 if smoke else 16384)
+    tree = {"g": jnp.asarray(rng.randn(L).astype(np.float32))}
+    resid = jnp.zeros((ndev, L), jnp.float32)
+
+    def build_exchange(kern_cfg):
+        def body(t, r):
+            return comm.error_feedback_allreduce(
+                t, r, spec8, axis_name="dp", axis_size=ndev,
+                kernels=kern_cfg)
+        return jax.jit(shard_map(body, mesh=mesh, in_specs=(P(), P("dp")),
+                                 out_specs=(P(), P("dp")), check_vma=False))
+
+    hlo_delta = {}
+    for label, cfg in (("codec", False),
+                       ("kernels", comm.CommKernelConfig())):
+        f = build_exchange(cfg)
+        hlo = f.lower(tree, resid).compile().as_text()
+        hlo_delta[label] = {
+            "full_slab_quantize_passes":
+                comm.hlo_quantize_pass_count(hlo, min_elements=L),
+            "collective_wire_bytes": round(sum(
+                r["wire_bytes"] for r in comm.hlo_collective_table(
+                    hlo, default_group_size=ndev)), 1),
+            "step_ms": round(time_fn(f, tree, resid) * 1e3, 3),
+        }
+    passes_cut = (hlo_delta["codec"]["full_slab_quantize_passes"]
+                  - hlo_delta["kernels"]["full_slab_quantize_passes"])
+
+    # -- (c) fused-Adam step-time delta + parity ---------------------------
+    apply_f = jax.jit(lambda p, g, st: adam_f.apply(p, g, st, lr))
+    apply_u = jax.jit(lambda p, g, st: adam_u.apply(p, g, st, lr))
+    pf, sf = apply_f(params, grads, states)
+    pu, su = apply_u(params, grads, states)
+    adam_parity = all(
+        bool(jnp.all(pf[n] == pu[n])) for n in names) and all(
+        bool(jnp.all(sf[n][i] == su[n][i]))
+        for n in names for i in range(3))
+    adam_row = {
+        "fused_ms": round(time_fn(apply_f, params, grads, states) * 1e3, 4),
+        "per_leaf_ms": round(
+            time_fn(apply_u, params, grads, states) * 1e3, 4),
+        "bitwise_parity": bool(adam_parity),
+        "param_elements": int(sum(int(np.prod(sh)) for sh in shapes)),
+    }
+
+    y_ref = x_mm @ w_mm.T
+    y_q = pk.int8_matmul(x_mm, w_mm)
+    mm_err = float(jnp.linalg.norm(y_q - y_ref) / jnp.linalg.norm(y_ref))
+
+    result = {
+        "metric": "kernel_bench_full_slab_quantize_passes_removed",
+        "value": passes_cut,
+        "unit": "hlo_passes",
+        "vs_baseline": hlo_delta["codec"]["full_slab_quantize_passes"],
+        "smoke": bool(smoke),
+        "interpret_mode": bool(pk.use_interpret()),
+        "measured_peak_gflops_s": round(peak / 1e9, 2),
+        "kernels": kernels,
+        "hlo_fused_vs_unfused": hlo_delta,
+        "wire_bytes_identical": (
+            hlo_delta["codec"]["collective_wire_bytes"]
+            == hlo_delta["kernels"]["collective_wire_bytes"]),
+        "fused_adam": adam_row,
+        "int8_matmul_rel_error": round(mm_err, 6),
+        "catalog": pk.catalog(),
+        "notes": (
+            "CPU rig: kernels run under the Pallas interpreter, so ms/"
+            "achieved-rate columns price the interpreter, not Mosaic — "
+            "the registry flops/bytes and the HLO pass/wire deltas are "
+            "the numbers that transfer to TPU (schema ready; flash's "
+            "on-chip rates are in FLASH_r05.json). wire bytes must be "
+            "identical between codec and kernel paths: same bits, fewer "
+            "passes."),
+    }
+    print(json.dumps(result))
+    if not smoke:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_KERNELS_r16.json")
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(f"wrote {out}", file=sys.stderr)
+
+
 def run_lockwatch_bench(args):
     """--lockwatch-bench: price the runtime lock-order watchdog (ISSUE 11).
 
@@ -1859,6 +2065,13 @@ def main():
                          "headline = fraction of per-chip goodput "
                          "recovered -> BENCH_CONTROLLER_r15.json (one "
                          "JSON line with --smoke)")
+    ap.add_argument("--kernel-bench", action="store_true",
+                    help="Pallas kernel layer (ISSUE 13): per-kernel "
+                         "roofline rows (registry FLOP/byte models vs "
+                         "measured time), fused-vs-unfused quantize HLO "
+                         "pass counts on the dp-8 exchange, fused-Adam "
+                         "step-time delta -> BENCH_KERNELS_r16.json (one "
+                         "JSON line with --smoke)")
     ap.add_argument("--lockwatch-bench", action="store_true",
                     help="price the runtime lock-order watchdog (ISSUE "
                          "11): group-kvstore churn + elastic-resize fit "
@@ -1913,6 +2126,18 @@ def main():
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8").strip()
         run_overlap_bench(args)
+        return
+
+    if args.kernel_bench:
+        # same CPU-mesh rig: interpret-mode kernels + HLO structure are
+        # measurable without hardware (the roofline row schema is the
+        # TPU contract; on-chip rates come from the tunnel runs)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        run_kernel_bench(args)
         return
 
     if args.telemetry_bench:

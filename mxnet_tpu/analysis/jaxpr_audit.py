@@ -64,21 +64,31 @@ def _aval_bytes(aval):
     return int(size) * dtype.itemsize
 
 
-def _iter_eqns(jaxpr):
-    """Yield every eqn in the jaxpr, recursing through nested jaxprs."""
+def _iter_eqns(jaxpr, skip_inner=None):
+    """Yield every eqn in the jaxpr, recursing through nested jaxprs.
+
+    ``skip_inner(eqn) -> bool`` suppresses recursion into an eqn's inner
+    jaxprs — how registry-attributed ``pallas_call`` regions avoid double
+    counting (the kernel body describes ONE grid cell; the registry's
+    model prices the whole call)."""
     for eqn in jaxpr.eqns:
+        # evaluate BEFORE yielding: the consumer reads the attribution
+        # side effect for this eqn as soon as it receives it
+        skip = skip_inner is not None and skip_inner(eqn)
         yield eqn
+        if skip:
+            continue
         for key in _INNER_JAXPR_PARAMS:
             sub = eqn.params.get(key)
             if sub is None:
                 continue
             inner = getattr(sub, "jaxpr", sub)
             if hasattr(inner, "eqns"):
-                yield from _iter_eqns(inner)
+                yield from _iter_eqns(inner, skip_inner)
         for branch in eqn.params.get("branches", ()):
             inner = getattr(branch, "jaxpr", branch)
             if hasattr(inner, "eqns"):
-                yield from _iter_eqns(inner)
+                yield from _iter_eqns(inner, skip_inner)
 
 
 def _eqn_flops(eqn):
@@ -123,15 +133,31 @@ def _is_float(dtype):
     return np.issubdtype(dtype, np.floating)
 
 
-def audit_jaxpr(closed_jaxpr, intended_dtype=None) -> AuditReport:
+def audit_jaxpr(closed_jaxpr, intended_dtype=None,
+                attribute_kernels=True) -> AuditReport:
     """Audit a ClosedJaxpr: host transfers, dtype promotions, cost table.
 
     ``intended_dtype``: the dtype the program is supposed to compute in
     (e.g. jnp.bfloat16). Any eqn producing a *wider* float output from
     inputs of the intended dtype is flagged MX502 — except dot_general /
     conv, where a wider accumulator is the correct MXU usage.
+
+    ``attribute_kernels``: price registered Pallas kernels through the
+    kernel registry (ops/pallas/registry.py) — a ``pallas_call`` whose
+    ``name=`` has a registered FLOP/byte model lands as its own
+    ``pallas::<name>`` row and its inner jaxpr is NOT recursed into
+    (which would count one grid cell and under-report by the grid size —
+    the pre-registry behavior that made flash attention invisible to the
+    MFU accountant). Unregistered pallas calls keep the legacy path.
     """
     import numpy as np
+
+    kreg = None
+    if attribute_kernels:
+        try:
+            from ..ops.pallas import registry as kreg
+        except Exception:  # kernel layer unavailable: audit still works
+            kreg = None
 
     report = AuditReport()
     jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
@@ -139,8 +165,30 @@ def audit_jaxpr(closed_jaxpr, intended_dtype=None) -> AuditReport:
     by_coll: dict[str, dict] = {}
     intended = np.dtype(intended_dtype) if intended_dtype is not None else None
 
-    for eqn in _iter_eqns(jaxpr):
+    attributed = {}  # id(eqn) -> (kernel_name, KernelCost)
+
+    def _skip_inner(eqn):
+        if kreg is None or eqn.primitive.name != "pallas_call":
+            return False
+        attr = kreg.attribute_eqn(eqn)
+        if attr is None:
+            return False
+        attributed[id(eqn)] = attr
+        return True
+
+    for eqn in _iter_eqns(jaxpr, _skip_inner):
         name = eqn.primitive.name
+        attr = attributed.get(id(eqn))
+        if attr is not None:
+            kname, cost = attr
+            row = by_prim.setdefault(
+                f"pallas::{kname}",
+                {"primitive": f"pallas::{kname}", "count": 0, "flops": 0,
+                 "bytes": 0})
+            row["count"] += 1
+            row["flops"] += cost.flops
+            row["bytes"] += cost.bytes
+            continue
         row = by_prim.setdefault(
             name, {"primitive": name, "count": 0, "flops": 0, "bytes": 0})
         row["count"] += 1
@@ -214,12 +262,15 @@ def audit_executor(executor, is_train=False,
     return audit_jaxpr(closed, intended_dtype=intended_dtype)
 
 
-def cost_rows(fn, *example_args, intended_dtype=None):
+def cost_rows(fn, *example_args, intended_dtype=None,
+              attribute_kernels=True):
     """Per-primitive FLOP/byte rows for an arbitrary traceable callable —
     the hook tools/bench_roofline.py uses to cross-check its HLO-level
-    accounting against the pre-fusion jaxpr."""
+    accounting against the pre-fusion jaxpr. Registered Pallas kernels
+    land as ``pallas::<name>`` rows priced by the kernel registry."""
     import jax
 
     closed = jax.make_jaxpr(fn)(*example_args)
-    report = audit_jaxpr(closed, intended_dtype=intended_dtype)
+    report = audit_jaxpr(closed, intended_dtype=intended_dtype,
+                         attribute_kernels=attribute_kernels)
     return report.rows, report.totals

@@ -231,6 +231,22 @@ register_rule(
     "`# mxlint: disable=MX311` with a justification")
 
 register_rule(
+    "MX312", "warning",
+    "Pallas kernel outside the kernel layer, or unpriced: a "
+    "`pl.pallas_call` outside mxnet_tpu/ops/pallas/ bypasses the kernel "
+    "registry, the shared interpret-mode gate, and the catalog/roofline "
+    "discipline; a module inside ops/pallas/ that emits a pallas_call "
+    "without registering a FLOP/byte model leaves that kernel invisible "
+    "to the jaxpr auditor — the MFU accountant and `bench_roofline "
+    "--jaxpr-table` under-count every program using it (the bug class "
+    "that hid flash attention's FLOPs from the PR 5 MFU path)",
+    "move the kernel into mxnet_tpu/ops/pallas/ and call "
+    "registry.register_kernel(name, cost_fn) with the `name=` the "
+    "pallas_call is emitted under; a deliberate out-of-layer kernel "
+    "(prototype, vendored code) carries `# mxlint: disable=MX312` with "
+    "a justification")
+
+register_rule(
     "MX306", "warning",
     "un-barriered wall-clock delta around device dispatch: a "
     "time.time()/perf_counter() start/stop pair with work between and no "

@@ -910,6 +910,55 @@ def _scan_unpinned_collectives(tree, path, findings):
                     path=path, line=lineno, col=col))
 
 
+# -- MX312: pallas kernel discipline ------------------------------------------
+# Two shapes of the same drift (ISSUE 13): a `pl.pallas_call` emitted
+# outside mxnet_tpu/ops/pallas/ escapes the kernel layer's registry,
+# interpret-mode gate, and roofline accounting; a kernel module inside
+# the layer that never calls registry.register_kernel leaves its kernel
+# unpriced — the jaxpr auditor falls back to one-grid-cell recursion and
+# the MFU/roofline numbers silently under-count. Zero-FP-biased: only
+# literal `pallas_call` call sites fire, and in-layer modules are excused
+# by ANY register_kernel call (the name<->model pairing is enforced by
+# the parity/attribution tests, not the lint).
+
+
+def _pallas_scoped(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    return "pallas" in parts
+
+
+def _scan_kernel_discipline(tree, path, findings):
+    calls, registers = [], False
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else getattr(node.func, "id", None)
+        if name == "pallas_call":
+            calls.append(node)
+        elif name == "register_kernel":
+            registers = True
+    if not calls:
+        return
+    if not _pallas_scoped(path):
+        for node in calls:
+            findings.append(Finding(
+                get_rule("MX312"),
+                "`pl.pallas_call` outside mxnet_tpu/ops/pallas/ — kernels "
+                "live in the kernel layer (registry cost model, shared "
+                "interpret gate, catalog + roofline rows)",
+                path=path, line=node.lineno, col=node.col_offset))
+        return
+    if not registers:
+        node = calls[0]
+        findings.append(Finding(
+            get_rule("MX312"),
+            "kernel module emits pallas_call but never registers a "
+            "FLOP/byte model (registry.register_kernel) — the jaxpr "
+            "auditor and MFU accountant will under-count it",
+            path=path, line=node.lineno, col=node.col_offset))
+
+
 # -- MX311: fleet actuation outside the policy loop ---------------------------
 # ISSUE 12: actuation must flow through resilience/controller.py so every
 # membership/tier change carries the controller's safety rails (hysteresis,
@@ -1090,6 +1139,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     _scan_step_loop_syncs(tree, path, scan.imports, scan.findings)
     _scan_world_literal_closures(tree, path, scan.findings)
     _scan_fleet_actuation(tree, path, scan.findings)
+    _scan_kernel_discipline(tree, path, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)
     roots += [d for d in scan.defs if d.name in scan.traced_names]

@@ -26,6 +26,12 @@ class MXNetError(Exception):
 MXNetTPUError = MXNetError
 
 
+# Shared env-gate token vocabularies (one copy; the per-config resolve()
+# helpers across comm/ops layer their own unset/default semantics on top)
+ENV_ON_VALUES = ("1", "on", "true", "yes")
+ENV_OFF_VALUES = ("0", "off", "false", "no", "none")
+
+
 def env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
     return int(v) if v not in (None, "") else default

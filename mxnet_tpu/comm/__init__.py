@@ -31,8 +31,9 @@ Entry points: ``FeedForward.fit(compression=..., overlap=...)``,
 
 from .compression import (CompressionSpec, decode, encode, payload_nbytes,
                           payload_bytes_of, quantization_unit)
-from .allreduce import (compressed_allreduce, error_feedback_allreduce,
-                        init_error_feedback, flat_size, padded_flat_size)
+from .allreduce import (CommKernelConfig, compressed_allreduce,
+                        error_feedback_allreduce, init_error_feedback,
+                        flat_size, padded_flat_size)
 from .bucketing import (DEFAULT_BUCKET_BYTES, GradBucketer, HostCodec,
                         decode_payload)
 from .overlap import (OverlapConfig, OverlapPlan, fused_layout_key,
@@ -41,13 +42,14 @@ from .overlap import (OverlapConfig, OverlapPlan, fused_layout_key,
                       residuals_match_plan, reverse_topo_param_order)
 from .stats import (CommRegistry, allreduce_plan, comm_stats,
                     fp32_allreduce_wire_bytes, hlo_collective_table,
-                    hlo_collective_wire_bytes, overlap_plan, registry,
+                    hlo_collective_wire_bytes, hlo_elementwise_table,
+                    hlo_quantize_pass_count, overlap_plan, registry,
                     reset_comm_stats)
 
 __all__ = [
     "CompressionSpec", "encode", "decode", "payload_nbytes",
     "payload_bytes_of", "quantization_unit",
-    "compressed_allreduce", "error_feedback_allreduce",
+    "CommKernelConfig", "compressed_allreduce", "error_feedback_allreduce",
     "init_error_feedback", "flat_size", "padded_flat_size",
     "GradBucketer", "HostCodec", "decode_payload", "DEFAULT_BUCKET_BYTES",
     "OverlapConfig", "OverlapPlan", "plan_overlap", "overlap_allreduce",
@@ -56,4 +58,5 @@ __all__ = [
     "CommRegistry", "registry", "comm_stats", "reset_comm_stats",
     "allreduce_plan", "overlap_plan", "fp32_allreduce_wire_bytes",
     "hlo_collective_table", "hlo_collective_wire_bytes",
+    "hlo_elementwise_table", "hlo_quantize_pass_count",
 ]

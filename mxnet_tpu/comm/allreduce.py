@@ -29,15 +29,76 @@ estimation.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..base import MXNetError
+from ..base import ENV_OFF_VALUES, ENV_ON_VALUES, MXNetError
 from .compression import CompressionSpec, decode, encode, quantization_unit
 
-__all__ = ["compressed_allreduce", "error_feedback_allreduce",
-           "init_error_feedback", "flat_size", "padded_flat_size"]
+__all__ = ["CommKernelConfig", "compressed_allreduce",
+           "error_feedback_allreduce", "init_error_feedback", "flat_size",
+           "padded_flat_size"]
+
+
+class CommKernelConfig:
+    """Route the quantize/dequantize stages through the fused Pallas
+    kernels (ops/pallas/comm_kernels.py) instead of the jnp reference
+    codecs.
+
+    Same wire bits either way (the kernels are bitwise-parity with
+    compression.py, test-enforced); what changes is the HLO: the codec
+    path costs one full-slab elementwise pass per encode/decode stage,
+    the kernel path streams each slab block through VMEM once
+    (quantize + scales + error-feedback round-trip fused). ``block_elems``
+    caps the per-block VMEM footprint; ``interpret`` overrides the
+    shared ops/pallas gate for this config only.
+    """
+
+    def __init__(self, block_elems=None, interpret=None):
+        self.block_elems = None if block_elems is None else int(block_elems)
+        if self.block_elems is not None and self.block_elems <= 0:
+            raise MXNetError("comm kernel block_elems must be positive")
+        self.interpret = interpret
+
+    def __repr__(self):
+        return (f"CommKernelConfig(block_elems={self.block_elems}, "
+                f"interpret={self.interpret})")
+
+    def key(self):
+        """Hashable identity (train-program cache key component)."""
+        return ("comm_kernels", self.block_elems, self.interpret)
+
+    @classmethod
+    def resolve(cls, value):
+        """Normalize a user-facing ``comm_kernels`` argument: None ->
+        env gate ``MXNET_TPU_COMM_KERNELS`` (unset/falsy = codec path,
+        truthy = kernels, an integer = the block-element cap,
+        anything else raises — a typo must not silently arm a path);
+        True -> kernels with defaults; an int -> that cap; a config
+        passes through. Returns None (codec path) or a CommKernelConfig."""
+        if value is None:
+            raw = os.environ.get("MXNET_TPU_COMM_KERNELS",
+                                 "").strip().lower()
+            if raw in ("",) + ENV_OFF_VALUES:
+                return None
+            if raw in ENV_ON_VALUES:
+                return cls()
+            try:
+                return cls(int(raw))
+            except ValueError:
+                raise MXNetError(
+                    f"MXNET_TPU_COMM_KERNELS={raw!r} not understood "
+                    "(use 1/0 or a block-element cap)") from None
+        if value is False:
+            return None
+        if value is True:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        return cls(int(value))
 
 # stage-2 (all-gather) codec for twobit: the reduced shard holds sums in
 # multiples of ±threshold, outside the 2-bit alphabet
@@ -81,18 +142,33 @@ def padded_flat_size(num_elements: int, spec: CompressionSpec,
     return -(-int(num_elements) // unit) * unit
 
 
-def _exchange(flat, spec, axis_name, axis_size):
+def _exchange(flat, spec, axis_name, axis_size, kernels=None):
     """The quantized allreduce over a padded flat vector.
 
     Returns ``(out, rows, dq1, shard, dq2, per)`` — the reduced vector plus
-    the intermediates error feedback needs (all local, no extra comm)."""
+    the intermediates error feedback needs (all local, no extra comm).
+
+    With ``kernels`` (a CommKernelConfig) the quantize/dequantize stages
+    run as the fused Pallas kernels: stage-1 emits payload + scales + the
+    error-feedback round-trip in one VMEM pass, the reduce-scatter decode
+    fuses with its f32 accumulate, and the all-gather decode is one
+    blocked pass — same wire bits (kernel/codec bitwise parity is
+    test-enforced), fewer full-slab elementwise HLO passes."""
     Lp = flat.shape[0]
     per = Lp // axis_size
     rows = flat.reshape(axis_size, per)
-    payload = encode(spec, rows)
-    # decode of OUR OWN payload: exactly what peers will reconstruct from
-    # our rows — the basis of the error-feedback residual
-    dq1 = decode(spec, payload)
+    use_k = kernels is not None and spec.mode in ("int8", "twobit")
+    if use_k:
+        from ..ops.pallas import comm_kernels as pk
+
+        payload, dq1 = pk.fused_quantize(
+            spec, rows, want_dequant=True,
+            block_elems=kernels.block_elems, interpret=kernels.interpret)
+    else:
+        payload = encode(spec, rows)
+        # decode of OUR OWN payload: exactly what peers will reconstruct
+        # from our rows — the basis of the error-feedback residual
+        dq1 = decode(spec, payload)
     # optimization_barrier on BOTH sides of each collective: converting
     # before/after pure data movement is elementwise-equivalent, so XLA
     # happily commutes the encode/decode converts across the collective —
@@ -102,15 +178,33 @@ def _exchange(flat, spec, axis_name, axis_size):
     recv = {k: lax.all_to_all(v, axis_name, split_axis=0, concat_axis=0,
                               tiled=True) for k, v in payload.items()}
     recv = lax.optimization_barrier(recv)
-    shard = jnp.sum(decode(spec, recv), axis=0)  # (per,) f32: my reduced shard
+    if use_k:
+        # fused dequant + f32 accumulate: the decoded (ndev, per) slab
+        # never materializes
+        shard = pk.fused_dequant_sum(spec, recv,
+                                     block_elems=kernels.block_elems,
+                                     interpret=kernels.interpret)
+    else:
+        shard = jnp.sum(decode(spec, recv), axis=0)  # (per,) f32 shard
     gspec = _gather_spec(spec)
-    payload2 = encode(gspec, shard)
-    dq2 = decode(gspec, payload2)
+    if use_k and gspec.mode == spec.mode:
+        payload2, dq2 = pk.fused_quantize(
+            spec, shard, want_dequant=True,
+            block_elems=kernels.block_elems, interpret=kernels.interpret)
+    else:
+        # twobit gathers in bf16 — a plain dtype convert, no kernel to fuse
+        payload2 = encode(gspec, shard)
+        dq2 = decode(gspec, payload2)
     payload2 = lax.optimization_barrier(payload2)
     gathered = {k: lax.all_gather(v, axis_name, axis=0, tiled=False)
                 for k, v in payload2.items()}
     gathered = lax.optimization_barrier(gathered)
-    out = decode(gspec, gathered).reshape(Lp)
+    if use_k and gspec.mode == spec.mode:
+        out = pk.fused_dequant(spec, gathered,
+                               block_elems=kernels.block_elems,
+                               interpret=kernels.interpret).reshape(Lp)
+    else:
+        out = decode(gspec, gathered).reshape(Lp)
     return out, rows, dq1, shard, dq2, per
 
 
@@ -123,7 +217,7 @@ def _pad_flat(flat, spec, axis_size):
 
 
 def compressed_allreduce(tree, compression=None, axis_name="dp",
-                         axis_size=None, average=True):
+                         axis_size=None, average=True, kernels=None):
     """Allreduce a gradient pytree over ``axis_name`` (inside shard_map).
 
     ``compression=None``/'none' keeps the exact legacy semantics — a
@@ -131,7 +225,9 @@ def compressed_allreduce(tree, compression=None, axis_name="dp",
     psums over gradients; mxlint MX304 flags them elsewhere). Compressed
     modes fuse the tree into one flat bucket and run the quantized
     decomposition; ``axis_size`` (the mesh's data-axis extent) is required
-    because the reshape needs a static device count.
+    because the reshape needs a static device count. ``kernels`` (a
+    :class:`CommKernelConfig`, or anything its ``resolve`` accepts)
+    routes the quantize stages through the fused Pallas kernels.
     """
     spec = CompressionSpec.resolve(compression)
     if spec is None:
@@ -154,7 +250,8 @@ def compressed_allreduce(tree, compression=None, axis_name="dp",
         return tree
     flat, meta = _flatten(tree)
     flat, L = _pad_flat(flat, spec, axis_size)
-    out, *_ = _exchange(flat, spec, axis_name, axis_size)
+    out, *_ = _exchange(flat, spec, axis_name, axis_size,
+                        kernels=CommKernelConfig.resolve(kernels))
     out = out[:L]
     if average:
         out = out / axis_size
@@ -162,7 +259,7 @@ def compressed_allreduce(tree, compression=None, axis_name="dp",
 
 
 def error_feedback_allreduce(tree, residual, compression, axis_name="dp",
-                             axis_size=None, average=False):
+                             axis_size=None, average=False, kernels=None):
     """Compressed allreduce with the residual threaded through.
 
     ``residual`` is this device's ``(1, Lp)`` slice of the carried
@@ -173,7 +270,8 @@ def error_feedback_allreduce(tree, residual, compression, axis_name="dp",
     spec = CompressionSpec.resolve(compression)
     if spec is None or not spec.error_feedback or residual is None:
         out = compressed_allreduce(tree, spec, axis_name=axis_name,
-                                   axis_size=axis_size, average=average)
+                                   axis_size=axis_size, average=average,
+                                   kernels=kernels)
         return out, residual
     if axis_size is None:
         raise MXNetError("error_feedback_allreduce needs axis_size=")
@@ -192,7 +290,8 @@ def error_feedback_allreduce(tree, residual, compression, axis_name="dp",
     total = residual[0].at[:L].add(flat) if Lp > L \
         else residual[0] + flat
     out, rows, dq1, shard, dq2, per = _exchange(
-        total, spec, axis_name, axis_size)
+        total, spec, axis_name, axis_size,
+        kernels=CommKernelConfig.resolve(kernels))
     # stage-1 error: what OUR quantized rows dropped. Stage-2 error (the
     # reduced-shard re-quantization) is charged once, to the shard's owner.
     new_rows = rows - dq1

@@ -226,7 +226,8 @@ def plan_overlap(shapes, compression, axis_size,
     return OverlapPlan(spec, axis_size, buckets)
 
 
-def overlap_allreduce(tree, residuals, plan, axis_name="dp", average=False):
+def overlap_allreduce(tree, residuals, plan, axis_name="dp", average=False,
+                      kernels=None):
     """Sync a gradient pytree as independent per-bucket collective pairs
     (call inside shard_map, like :func:`compressed_allreduce`).
 
@@ -239,6 +240,8 @@ def overlap_allreduce(tree, residuals, plan, axis_name="dp", average=False):
     the carried ``(axis_size, Lp_b)`` error-feedback state
     (:func:`init_overlap_residuals`, ``P(axis)``-sharded), or None for
     modes without feedback. Returns ``(synced_tree, new_residuals)``.
+    ``kernels`` (a CommKernelConfig) routes each bucket's quantize
+    stages through the fused Pallas kernels, same as the fused path.
     """
     missing = [k for k in plan.param_keys() if k not in tree]
     extra = [k for k in tree if k not in set(plan.param_keys())]
@@ -255,12 +258,12 @@ def overlap_allreduce(tree, residuals, plan, axis_name="dp", average=False):
         if use_ef:
             synced, r = error_feedback_allreduce(
                 sub, residuals[b["name"]], plan.spec, axis_name=axis_name,
-                axis_size=plan.axis_size, average=average)
+                axis_size=plan.axis_size, average=average, kernels=kernels)
             new_res[b["name"]] = r
         else:
             synced = compressed_allreduce(
                 sub, plan.spec, axis_name=axis_name,
-                axis_size=plan.axis_size, average=average)
+                axis_size=plan.axis_size, average=average, kernels=kernels)
         out.update(synced)
     return out, new_res
 

@@ -36,7 +36,8 @@ from .compression import CompressionSpec, payload_nbytes, quantization_unit
 
 __all__ = ["allreduce_plan", "overlap_plan", "fp32_allreduce_wire_bytes",
            "CommRegistry", "registry", "comm_stats", "reset_comm_stats",
-           "hlo_collective_table", "hlo_collective_wire_bytes"]
+           "hlo_collective_table", "hlo_collective_wire_bytes",
+           "hlo_elementwise_table", "hlo_quantize_pass_count"]
 
 
 # -- plan arithmetic -----------------------------------------------------------
@@ -337,3 +338,68 @@ def hlo_collective_wire_bytes(hlo_text: str,
     """Total wire bytes of every collective in a compiled HLO module."""
     return sum(r["wire_bytes"] for r in
                hlo_collective_table(hlo_text, default_group_size))
+
+
+# -- elementwise-pass extraction ----------------------------------------------
+# The encode/decode cost the fused comm kernels (ops/pallas/comm_kernels)
+# exist to remove shows up in HLO as full-slab elementwise instructions:
+# each quantize stage is a chain of round/clamp/divide/... ops whose
+# result covers the whole gradient slab. Counting instructions at or
+# above a slab-sized element threshold measures exactly that — the
+# kernel path's quantize math lives inside per-BLOCK kernel bodies, so
+# its instructions stay under the threshold and the full-slab count
+# drops (asserted by tests/test_pallas_kernels.py and --kernel-bench).
+
+_GENERIC_INSTR_RE = re.compile(
+    r"=\s*((?:pred|bf16|f16|f32|f64|s8|u8|s16|u16|s32|u32|s64|u64)"
+    r"\[[\d,]*\])\S*\s+([a-z][a-z0-9-]*)\(")
+
+# the opcodes a quantize/dequantize stage is made of
+_QUANTIZE_OPS = frozenset({
+    "round-nearest-even", "round-nearest-afz", "clamp", "divide",
+    "multiply", "abs", "maximum", "minimum",
+})
+
+
+def _shape_elems(shape_str: str) -> int:
+    m = _SHAPE_RE.match(shape_str)
+    if not m:
+        return 0
+    n = 1
+    for d in filter(None, m.group(2).split(",")):
+        n *= int(d)
+    return n
+
+
+def hlo_elementwise_table(hlo_text: str, min_elements: int = 0,
+                          ops=None) -> list:
+    """Per-opcode counts of (large) elementwise-shaped HLO instructions.
+
+    Each row: ``{"op", "count", "elements"}`` for instructions whose
+    result holds at least ``min_elements`` elements; ``ops`` restricts to
+    an opcode set (default: every matched opcode). Fusion-computation
+    bodies count too — a pass is a pass wherever XLA parked it."""
+    by_op: dict[str, dict] = {}
+    for line in hlo_text.splitlines():
+        m = _GENERIC_INSTR_RE.search(line)
+        if not m:
+            continue
+        shape_str, op = m.group(1), m.group(2)
+        if ops is not None and op not in ops:
+            continue
+        elems = _shape_elems(shape_str)
+        if elems < min_elements:
+            continue
+        row = by_op.setdefault(op, {"op": op, "count": 0, "elements": 0})
+        row["count"] += 1
+        row["elements"] += elems
+    return sorted(by_op.values(), key=lambda r: (-r["count"], r["op"]))
+
+
+def hlo_quantize_pass_count(hlo_text: str, min_elements: int) -> int:
+    """How many full-slab quantize-shaped passes a compiled module runs:
+    the encode/decode HLO op-count metric the fused comm kernels are
+    measured by (lower is better; the wire bits are identical)."""
+    return sum(r["count"] for r in
+               hlo_elementwise_table(hlo_text, min_elements,
+                                     ops=_QUANTIZE_OPS))

@@ -498,7 +498,8 @@ class FeedForward(BASE_ESTIMATOR):
 
     def _get_train_step(self, bucket_key, data_names, label_names, optimizer,
                         mesh, metric=None, apply_update=True, guard_cfg=None,
-                        pad_policy=None, compression=None, overlap_plan=None):
+                        pad_policy=None, compression=None, overlap_plan=None,
+                        comm_kernels=None):
         """The fused train step for one program configuration, built once
         and cached on the instance (reference analog: GraphExecutor's
         cached engine ops, one per shape). precompile() populates the same
@@ -510,6 +511,7 @@ class FeedForward(BASE_ESTIMATOR):
                None if pad_policy is None else pad_policy.key(),
                None if compression is None else compression.key(),
                None if overlap_plan is None else overlap_plan.layout_key(),
+               None if comm_kernels is None else comm_kernels.key(),
                str(self.compute_dtype))
         if key not in self._train_fns:
             warmed = sum(getattr(fn, "_tracked", None) is not None
@@ -531,13 +533,14 @@ class FeedForward(BASE_ESTIMATOR):
                 metric_update=None if metric is None else metric.device_update,
                 apply_update=apply_update, guard_cfg=guard_cfg,
                 pad_policy=pad_policy, compression=compression,
-                overlap_plan=overlap_plan, label=label)
+                overlap_plan=overlap_plan, comm_kernels=comm_kernels,
+                label=label)
         return self._train_fns[key]
 
     def _build_train_step(self, data_names, label_names, optimizer, mesh,
                           symbol=None, metric_update=None, apply_update=True,
                           guard_cfg=None, pad_policy=None, compression=None,
-                          overlap_plan=None, label=None):
+                          overlap_plan=None, comm_kernels=None, label=None):
         """Compile the fused train step.
 
         With ``guard_cfg`` (resilience.GuardConfig) the program additionally
@@ -580,6 +583,10 @@ class FeedForward(BASE_ESTIMATOR):
         in_shard = comm_spec is not None  # compute body runs inside shard_map
         axis_size = int(mesh.shape["dp"]) if mesh is not None else 1
         has_cstate = in_shard and comm_spec.error_feedback
+        # False (not None): the caller resolved the kernel gate once; None
+        # would re-read MXNET_TPU_COMM_KERNELS at trace time and could arm
+        # a path the program cache key doesn't know about
+        comm_kernels = comm_kernels if comm_kernels is not None else False
 
         def compute(params, opt_state, aux, batch, rng, lr, mstate, gstate,
                     valid, cstate=None):
@@ -623,18 +630,21 @@ class FeedForward(BASE_ESTIMATOR):
                 if overlap_plan is not None:
                     grads, resid = comm_mod.overlap_allreduce(
                         grads, cstate["resid"] if has_cstate else None,
-                        overlap_plan, axis_name="dp", average=False)
+                        overlap_plan, axis_name="dp", average=False,
+                        kernels=comm_kernels)
                     if has_cstate:
                         new_cstate = {"resid": resid}
                 elif has_cstate:
                     grads, resid = comm_mod.error_feedback_allreduce(
                         grads, cstate["resid"], comm_spec, axis_name="dp",
-                        axis_size=axis_size, average=False)
+                        axis_size=axis_size, average=False,
+                        kernels=comm_kernels)
                     new_cstate = {"resid": resid}
                 else:
                     grads = comm_mod.compressed_allreduce(
                         grads, comm_spec, axis_name="dp",
-                        axis_size=axis_size, average=False)
+                        axis_size=axis_size, average=False,
+                        kernels=comm_kernels)
                 loss = jax.lax.psum(loss, "dp")
                 new_aux = jax.tree_util.tree_map(
                     lambda a: jax.lax.pmean(a, "dp")
@@ -910,8 +920,8 @@ class FeedForward(BASE_ESTIMATOR):
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, batch_size=128,
             sharded_checkpoint_dir=None, guards=None, pad_policy=None,
-            compression=None, overlap=None, telemetry=None, elastic=None,
-            controller=None):
+            compression=None, overlap=None, comm_kernels=None,
+            telemetry=None, elastic=None, controller=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -968,6 +978,15 @@ class FeedForward(BASE_ESTIMATOR):
         ``overlap`` sub-span, and ``comm_overlap_efficiency`` gauges how
         much of the wire was hidden (doc/developer-guide/comm.md,
         "Overlap scheduler").
+
+        ``comm_kernels``: fused Pallas quantize/dequantize for the
+        compressed gradient sync — None (default; env gate
+        ``MXNET_TPU_COMM_KERNELS``), True, an int VMEM-block element
+        cap, or a comm.CommKernelConfig. Same wire bits as the reference
+        codecs (bitwise, test-enforced); the encode/decode stages stop
+        costing full-slab elementwise HLO passes
+        (doc/developer-guide/kernels.md). Only meaningful with a lossy
+        ``compression`` mode on the mesh path.
 
         ``telemetry``: observability control — None (default; env gate
         ``MXNET_TPU_TELEMETRY``), True, a JSONL path, or a
@@ -1026,6 +1045,7 @@ class FeedForward(BASE_ESTIMATOR):
 
         comm_spec = comm_mod.CompressionSpec.resolve(compression)
         overlap_cfg = comm_mod.OverlapConfig.resolve(overlap)
+        kern_cfg = comm_mod.CommKernelConfig.resolve(comm_kernels)
         resume_opt_leaves, resume_num_update = None, 0
         resume_scale = None
         resume_comm_state, resume_comm_layout = None, None
@@ -1582,6 +1602,8 @@ class FeedForward(BASE_ESTIMATOR):
                     else False,
                     overlap=overlap_cfg if overlap_cfg is not None
                     else False,
+                    comm_kernels=kern_cfg if kern_cfg is not None
+                    else False,
                     batch_end_callback=batch_end_callback)
             finally:
                 if rspan is not None:
@@ -1636,6 +1658,8 @@ class FeedForward(BASE_ESTIMATOR):
                     compression=comm_spec if comm_spec is not None
                     else False,
                     overlap=overlap_cfg if overlap_cfg is not None
+                    else False,
+                    comm_kernels=kern_cfg if kern_cfg is not None
                     else False,
                     batch_end_callback=batch_end_callback)
                 fleet_ctl.retier_applied(action, time.time() - t0)
@@ -1750,7 +1774,8 @@ class FeedForward(BASE_ESTIMATOR):
                             apply_update=not async_kv,
                             guard_cfg=guard_cfg, pad_policy=pad_policy,
                             compression=comm_spec,
-                            overlap_plan=overlap_plan)
+                            overlap_plan=overlap_plan,
+                            comm_kernels=kern_cfg)
                     train_step = train_steps[bkey]
                     pad_tail = ()
                     if pad_policy is not None:
@@ -2095,7 +2120,8 @@ class FeedForward(BASE_ESTIMATOR):
     def precompile(self, data_shapes=None, label_shapes=None, *, data=None,
                    eval_metric="accuracy", kvstore="local", guards=None,
                    pad_policy=None, compression=None, overlap=None,
-                   batch_end_callback=None, parallel=True):
+                   comm_kernels=None, batch_end_callback=None,
+                   parallel=True):
         """AOT warmup: compile every fused train program ``fit`` would need
         BEFORE training, via ``.lower().compile()`` — so step 1 of each
         shape dispatches a ready executable instead of stalling on XLA
@@ -2150,6 +2176,7 @@ class FeedForward(BASE_ESTIMATOR):
 
         comm_spec = comm_mod.CompressionSpec.resolve(compression)
         overlap_cfg = comm_mod.OverlapConfig.resolve(overlap)
+        kern_cfg = comm_mod.CommKernelConfig.resolve(comm_kernels)
         metric = metric_mod.create(eval_metric)
         # same fusion decision as fit(): a batch callback needs per-batch
         # host metric values, so the metric stays out of the step program
@@ -2211,7 +2238,7 @@ class FeedForward(BASE_ESTIMATOR):
                 metric=metric if use_device_metric else None,
                 apply_update=True, guard_cfg=guard_cfg,
                 pad_policy=pad_policy, compression=comm_spec,
-                overlap_plan=overlap_plan)
+                overlap_plan=overlap_plan, comm_kernels=kern_cfg)
             batch_s = {}
             for name, spec in {**d, **l}.items():
                 shape, dtype = _split(spec)

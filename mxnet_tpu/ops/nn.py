@@ -67,6 +67,20 @@ class FullyConnectedOp(OpProp):
     def fwd(self, ins, aux, is_train, rng):
         x = ins[0]
         x = x.reshape((x.shape[0], -1))
+        if not is_train:
+            # serving path: under int8_predict_scope (Predictor
+            # quantize="int8" / env MXNET_TPU_INT8_PREDICT) the matmul
+            # runs the int8 Pallas kernel — per-channel weight scales,
+            # f32 accumulate. Trace-time gate: armed when the program
+            # first traces (ops/pallas/matmul.py).
+            from .pallas.matmul import int8_matmul, int8_predict_active
+
+            if int8_predict_active():
+                y = int8_matmul(x.astype(jnp.float32),
+                                ins[1]).astype(x.dtype)
+                if not self.no_bias:
+                    y = y + ins[2].astype(x.dtype)
+                return [y], []
         w = ins[1].astype(x.dtype)
         y = lax.dot_general(
             x, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32

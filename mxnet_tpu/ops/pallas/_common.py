@@ -1,0 +1,43 @@
+"""Shared plumbing for every Pallas kernel in this package.
+
+One gate, one place: ``use_interpret()`` decides whether a kernel runs as
+a compiled Mosaic program (TPU) or through the Pallas interpreter (every
+other backend — the unit-test path: the SAME kernel code executes on the
+8-device CPU mesh). The old per-module ``_use_interpret`` read
+``jax.default_backend()`` wherever each kernel happened to call it at
+trace time, with no way to force interpret mode for a TPU-attached
+process (or force-compile in a test); the env override below closes both
+holes and every kernel module (old and new) routes through here.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ...base import ENV_OFF_VALUES, ENV_ON_VALUES
+
+__all__ = ["use_interpret", "resolve_interpret"]
+
+
+def use_interpret() -> bool:
+    """Should Pallas kernels run under the interpreter on this backend?
+
+    ``MXNET_TPU_PALLAS_INTERPRET`` overrides in both directions (truthy =
+    force interpret even on TPU — the "is it the kernel or Mosaic?"
+    bisection tool; falsy = force compiled). Unset/empty, interpret mode
+    is on exactly when the default backend is not a TPU, so tests
+    exercise the real kernel code paths without hardware.
+    """
+    raw = os.environ.get("MXNET_TPU_PALLAS_INTERPRET", "").strip().lower()
+    if raw in ENV_ON_VALUES:
+        return True
+    if raw in ENV_OFF_VALUES:
+        return False
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def resolve_interpret(interpret) -> bool:
+    """Normalize a kernel entry point's ``interpret=None`` default."""
+    return use_interpret() if interpret is None else bool(interpret)

@@ -1,0 +1,363 @@
+"""Fused gradient-compression kernels: quantize + scales in one VMEM pass.
+
+The comm layer's reference codecs (comm/compression.py) are pure jnp —
+correct, but XLA lowers each encode/decode as its own chain of full-slab
+elementwise passes (abs -> max -> divide -> round -> clip -> convert ...),
+each one a round-trip of the whole gradient bucket through HBM. EQuARX
+(arXiv 2506.17615) makes the case that quantization belongs *inside* the
+collective's kernel; these Pallas kernels are that shape for our
+decomposed allreduce: one pass that streams a slab block through VMEM and
+emits the wire payload AND the per-chunk scales (and, fused, the
+dequantized round-trip the error-feedback residual needs), plus the
+inverse pass that dequantizes received rows and accumulates the f32
+reduction without ever materializing the decoded (ndev, per) slab in HBM.
+
+The bitwise contract: for every mode the emitted wire payload is
+BIT-IDENTICAL to ``compression.encode``'s — the kernels reproduce the
+reference arithmetic exactly (same ops, same order), so a fleet can mix
+kernel and codec ranks mid-rollout and the wire, the error-feedback
+ledgers, and the convergence trajectory do not fork. Enforced by
+tests/test_pallas_kernels.py against the reference codecs.
+
+Entry points (all run under interpret mode off-TPU, ``_common`` gate):
+
+  fused_quantize      (R, L) f32 rows -> payload dict {q[, scale]}
+                      (+ the decode round-trip when ``want_dequant``)
+  fused_dequant_sum   payload rows -> (L,) f32 column sums (the
+                      reduce-scatter accumulate, decode fused in)
+  fused_dequant       payload rows -> (R, L) f32 (the all-gather side)
+
+Wired behind ``comm.CommKernelConfig`` (comm/allreduce.py) so the fused
+and codec paths stay selectable per program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...base import MXNetError
+from ._common import resolve_interpret
+from .registry import KernelCost, io_bytes, register_kernel
+
+__all__ = ["fused_quantize", "fused_dequant_sum", "fused_dequant",
+           "pick_block"]
+
+DEFAULT_BLOCK_ELEMS = 65536  # 256 KB of f32 per VMEM block
+
+
+def pick_block(length: int, unit: int, cap=None) -> int:
+    """Largest block size that divides ``length``, is a multiple of
+    ``unit`` (the mode's quantization granularity — scales/nibbles never
+    straddle blocks), and stays under ``cap`` elements."""
+    length, unit = int(length), int(unit)
+    cap = DEFAULT_BLOCK_ELEMS if cap is None else int(cap)
+    if length % unit:
+        raise MXNetError(f"row length {length} not a multiple of the "
+                         f"quantization unit {unit}")
+    k = length // unit
+    for m in range(min(k, max(cap // unit, 1)), 0, -1):
+        if k % m == 0:
+            return m * unit
+    return unit
+
+
+# --------------------------------------------------------------------------
+# quantize: payload (+ scales + dequant round-trip) in one pass
+# --------------------------------------------------------------------------
+
+def _quant_int8_kernel(x_ref, q_ref, s_ref, dq_ref, *, chunk, want_dq):
+    # mirrors compression.encode('int8') op-for-op: the payload must be
+    # bit-identical to the reference codec (wire-parity contract)
+    b = x_ref.shape[1]
+    xr = x_ref[:].reshape(b // chunk, chunk)
+    scale = jnp.maximum(jnp.max(jnp.abs(xr), axis=-1, keepdims=True) / 127.0,
+                        1e-30).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xr / scale), -127, 127)
+    q_ref[:] = q.astype(jnp.int8).reshape(1, b)
+    s_ref[:] = scale.reshape(1, b // chunk)
+    if want_dq:
+        # decode(encode(x)) fused in: q is integral, so the int8 cast
+        # round-trips exactly and the product matches the codec bitwise
+        dq_ref[:] = (q * scale).astype(jnp.float32).reshape(1, b)
+
+
+def _quant_twobit_kernel(x_ref, q_ref, dq_ref, *, threshold, want_dq):
+    b = x_ref.shape[1]
+    t = threshold
+    x = x_ref[:]
+    # inclusive boundary, exactly like the reference: +/-t transmits
+    c = (jnp.where(x >= t, 1, 0) + jnp.where(x <= -t, 2, 0)).astype(jnp.int32)
+    cr = c.reshape(b // 4, 4)
+    packed = (cr[:, 0:1] | (cr[:, 1:2] << 2) | (cr[:, 2:3] << 4)
+              | (cr[:, 3:4] << 6))
+    q_ref[:] = packed.astype(jnp.uint8).reshape(1, b // 4)
+    if want_dq:
+        dq = jnp.where(c == 1, t, 0.0) + jnp.where(c == 2, -t, 0.0)
+        dq_ref[:] = dq.astype(jnp.float32)
+
+
+def fused_quantize(spec, rows, *, want_dequant=False, block_elems=None,
+                   interpret=None):
+    """Quantize ``rows`` ((R, L) f32, L a multiple of the mode's unit)
+    into the wire payload dict — per-chunk scales computed in the same
+    VMEM pass — and, with ``want_dequant``, the decode round-trip the
+    error-feedback residual is built from. Returns ``(payload, dq)``
+    with ``dq=None`` unless requested; payload shapes match
+    ``compression.encode`` exactly."""
+    interpret = resolve_interpret(interpret)
+    rows = rows.astype(jnp.float32)
+    squeeze = rows.ndim == 1
+    if squeeze:
+        rows = rows[None]
+    R, L = rows.shape
+    if spec.mode == "int8":
+        B = pick_block(L, spec.chunk, block_elems)
+        nblk = L // B
+        kern = functools.partial(_quant_int8_kernel, chunk=spec.chunk,
+                                 want_dq=want_dequant)
+        out_shape = [
+            jax.ShapeDtypeStruct((R, L), jnp.int8),
+            jax.ShapeDtypeStruct((R, L // spec.chunk), jnp.float32),
+            jax.ShapeDtypeStruct((R, L) if want_dequant else (1, 1),
+                                 jnp.float32),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, B), lambda r, i: (r, i)),
+            pl.BlockSpec((1, B // spec.chunk), lambda r, i: (r, i)),
+            pl.BlockSpec((1, B), lambda r, i: (r, i)) if want_dequant
+            else pl.BlockSpec((1, 1), lambda r, i: (0, 0)),
+        ]
+        q, scale, dq = pl.pallas_call(
+            kern,
+            grid=(R, nblk),
+            in_specs=[pl.BlockSpec((1, B), lambda r, i: (r, i))],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+            name="quant_int8",
+        )(rows)
+        payload = {"q": q, "scale": scale}
+    elif spec.mode == "twobit":
+        B = pick_block(L, 4, block_elems)
+        nblk = L // B
+        kern = functools.partial(_quant_twobit_kernel,
+                                 threshold=spec.threshold,
+                                 want_dq=want_dequant)
+        q, dq = pl.pallas_call(
+            kern,
+            grid=(R, nblk),
+            in_specs=[pl.BlockSpec((1, B), lambda r, i: (r, i))],
+            out_specs=[
+                pl.BlockSpec((1, B // 4), lambda r, i: (r, i)),
+                pl.BlockSpec((1, B), lambda r, i: (r, i)) if want_dequant
+                else pl.BlockSpec((1, 1), lambda r, i: (0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((R, L // 4), jnp.uint8),
+                jax.ShapeDtypeStruct((R, L) if want_dequant else (1, 1),
+                                     jnp.float32),
+            ],
+            interpret=interpret,
+            name="quant_twobit",
+        )(rows)
+        payload = {"q": q}
+    else:
+        raise MXNetError(f"fused_quantize: no kernel for mode {spec.mode!r} "
+                         "(none/bf16 are plain converts)")
+    if squeeze:
+        payload = {k: v[0] for k, v in payload.items()}
+        if want_dequant:
+            dq = dq[0]
+    return payload, (dq if want_dequant else None)
+
+
+# --------------------------------------------------------------------------
+# dequantize (+ f32 accumulate): the inverse pass
+# --------------------------------------------------------------------------
+
+def _dq_int8_block(q, scale, chunk):
+    b = q.shape[1]
+    qr = q.astype(jnp.float32).reshape(b // chunk, chunk)
+    return (qr * scale.reshape(b // chunk, 1)).astype(
+        jnp.float32).reshape(1, b)
+
+
+def _dq_twobit_block(packed, threshold, b):
+    t = threshold
+    p = packed.astype(jnp.int32).reshape(b // 4, 1)
+    cols = [(p >> s) & 3 for s in (0, 2, 4, 6)]
+    c = jnp.concatenate(cols, axis=1)              # (b//4, 4) code layout
+    vals = jnp.where(c == 1, t, 0.0) + jnp.where(c == 2, -t, 0.0)
+    return vals.astype(jnp.float32).reshape(1, b)
+
+
+def _dqsum_int8_kernel(q_ref, s_ref, o_ref, acc, *, chunk, nrows):
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    acc[:] = acc[:] + _dq_int8_block(q_ref[:], s_ref[:], chunk)
+
+    @pl.when(r == nrows - 1)
+    def _fin():
+        o_ref[:] = acc[:]
+
+
+def _dqsum_twobit_kernel(q_ref, o_ref, acc, *, threshold, nrows, b):
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    acc[:] = acc[:] + _dq_twobit_block(q_ref[:], threshold, b)
+
+    @pl.when(r == nrows - 1)
+    def _fin():
+        o_ref[:] = acc[:]
+
+
+def fused_dequant_sum(spec, payload, *, block_elems=None, interpret=None):
+    """Decode payload rows and accumulate their f32 sum in one pass:
+    the reduce-scatter's ``sum(decode(recv), axis=0)`` without the
+    decoded (R, L) slab ever hitting HBM. Returns ``(L,) float32``."""
+    interpret = resolve_interpret(interpret)
+    q = payload["q"]
+    R = q.shape[0]
+    if spec.mode == "int8":
+        L = q.shape[1]
+        B = pick_block(L, spec.chunk, block_elems)
+        out = pl.pallas_call(
+            functools.partial(_dqsum_int8_kernel, chunk=spec.chunk,
+                              nrows=R),
+            grid=(L // B, R),
+            in_specs=[
+                pl.BlockSpec((1, B), lambda i, r: (r, i)),
+                pl.BlockSpec((1, B // spec.chunk), lambda i, r: (r, i)),
+            ],
+            out_specs=pl.BlockSpec((1, B), lambda i, r: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((1, L), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((1, B), jnp.float32)],
+            interpret=interpret,
+            name="dequant_sum_int8",
+        )(q, payload["scale"])
+        return out[0]
+    if spec.mode == "twobit":
+        L = q.shape[1] * 4
+        B = pick_block(L, 4, block_elems)
+        out = pl.pallas_call(
+            functools.partial(_dqsum_twobit_kernel,
+                              threshold=spec.threshold, nrows=R, b=B),
+            grid=(L // B, R),
+            in_specs=[pl.BlockSpec((1, B // 4), lambda i, r: (r, i))],
+            out_specs=pl.BlockSpec((1, B), lambda i, r: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((1, L), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((1, B), jnp.float32)],
+            interpret=interpret,
+            name="dequant_sum_twobit",
+        )(q)
+        return out[0]
+    raise MXNetError(f"fused_dequant_sum: no kernel for mode {spec.mode!r}")
+
+
+def _dq_int8_kernel(q_ref, s_ref, o_ref, *, chunk):
+    o_ref[:] = _dq_int8_block(q_ref[:], s_ref[:], chunk)
+
+
+def _dq_twobit_kernel(q_ref, o_ref, *, threshold, b):
+    o_ref[:] = _dq_twobit_block(q_ref[:], threshold, b)
+
+
+def fused_dequant(spec, payload, *, block_elems=None, interpret=None):
+    """Decode payload rows back to float32 (the all-gather side); same
+    values as ``compression.decode``, one blocked pass."""
+    interpret = resolve_interpret(interpret)
+    q = payload["q"]
+    squeeze = q.ndim == 1
+    if squeeze:
+        payload = {k: v[None] for k, v in payload.items()}
+        q = payload["q"]
+    R = q.shape[0]
+    if spec.mode == "int8":
+        L = q.shape[1]
+        B = pick_block(L, spec.chunk, block_elems)
+        out = pl.pallas_call(
+            functools.partial(_dq_int8_kernel, chunk=spec.chunk),
+            grid=(R, L // B),
+            in_specs=[
+                pl.BlockSpec((1, B), lambda r, i: (r, i)),
+                pl.BlockSpec((1, B // spec.chunk), lambda r, i: (r, i)),
+            ],
+            out_specs=pl.BlockSpec((1, B), lambda r, i: (r, i)),
+            out_shape=jax.ShapeDtypeStruct((R, L), jnp.float32),
+            interpret=interpret,
+            name="dequant_int8",
+        )(q, payload["scale"])
+    elif spec.mode == "twobit":
+        L = q.shape[1] * 4
+        B = pick_block(L, 4, block_elems)
+        out = pl.pallas_call(
+            functools.partial(_dq_twobit_kernel, threshold=spec.threshold,
+                              b=B),
+            grid=(R, L // B),
+            in_specs=[pl.BlockSpec((1, B // 4), lambda r, i: (r, i))],
+            out_specs=pl.BlockSpec((1, B), lambda r, i: (r, i)),
+            out_shape=jax.ShapeDtypeStruct((R, L), jnp.float32),
+            interpret=interpret,
+            name="dequant_twobit",
+        )(q)
+    else:
+        raise MXNetError(f"fused_dequant: no kernel for mode {spec.mode!r}")
+    return out[0] if squeeze else out
+
+
+# --------------------------------------------------------------------------
+# registry cost models — elementwise op counts per slab element
+# --------------------------------------------------------------------------
+
+def _elemwise_cost(ops_per_elem):
+    def cost(in_avals, out_avals):
+        n = max((int(getattr(a, "size", 0)) for a in in_avals), default=0)
+        return KernelCost(flops=float(ops_per_elem) * n,
+                          bytes=io_bytes(in_avals, out_avals))
+    return cost
+
+
+def _dq_cost(ops_per_elem, unpack=1):
+    # payload elements expand by `unpack` on decode (twobit: 4 per byte)
+    def cost(in_avals, out_avals):
+        n = max((int(getattr(a, "size", 0)) for a in out_avals), default=0)
+        if not n and in_avals:
+            n = int(getattr(in_avals[0], "size", 0)) * unpack
+        return KernelCost(flops=float(ops_per_elem) * n,
+                          bytes=io_bytes(in_avals, out_avals))
+    return cost
+
+
+register_kernel(
+    "quant_int8", _elemwise_cost(5), module=__name__,
+    doc="per-chunk-scaled int8 quantize + scales (+ fused dequant "
+        "round-trip) in one VMEM pass")
+register_kernel(
+    "quant_twobit", _elemwise_cost(5), module=__name__,
+    doc="threshold ternarize + 4-per-byte pack (+ fused dequant) in one "
+        "VMEM pass")
+register_kernel(
+    "dequant_sum_int8", _dq_cost(3), module=__name__,
+    doc="int8 dequantize fused with the f32 row-sum accumulate")
+register_kernel(
+    "dequant_sum_twobit", _dq_cost(5, unpack=4), module=__name__,
+    doc="twobit unpack/dequantize fused with the f32 row-sum accumulate")
+register_kernel(
+    "dequant_int8", _dq_cost(2), module=__name__,
+    doc="blocked int8 dequantize (all-gather side)")
+register_kernel(
+    "dequant_twobit", _dq_cost(4, unpack=4), module=__name__,
+    doc="blocked twobit unpack/dequantize (all-gather side)")

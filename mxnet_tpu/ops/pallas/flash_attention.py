@@ -28,12 +28,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import use_interpret as _use_interpret
+from .registry import io_bytes, register_kernel
+
 NEG_INF = -1e30  # large-negative instead of -inf: avoids inf-inf NaNs on VPU
 _LANES = 128     # TPU lane count; m/l scratch is broadcast across lanes
-
-
-def _use_interpret():
-    return jax.default_backend() != "tpu"
 
 
 def _mxu(x):
@@ -140,6 +139,7 @@ def _flash_fwd_padded(q, k, v, *, scale, causal, bq, bk, seq_k, interpret):
             pltpu.VMEM((bq, _LANES), jnp.float32),
         ],
         interpret=interpret,
+        name="flash_fwd",
     )(q, k, v)
     return o, lse
 
@@ -250,6 +250,7 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, scale, causal, bq, bk, seq_k,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
+        name="flash_bwd_dq",
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
@@ -275,6 +276,7 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, scale, causal, bq, bk, seq_k,
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
+        name="flash_bwd_dkv",
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
@@ -405,3 +407,57 @@ def flash_attention(q, k, v, causal=False, block_q=512, block_k=2048,
     vf = v.reshape(b * h, v.shape[2], d)
     o = _flash(qf, kf, vf, causal, bq, bk, interpret)
     return o.reshape(b, h, sq, d)
+
+
+# --------------------------------------------------------------------------
+# registry cost models (ops/pallas/registry.py contract)
+# --------------------------------------------------------------------------
+# Model FLOPs from the FULL (padded) avals — exact trace-time arithmetic,
+# comparable across runs. Counts the matmul work (the softmax elementwise
+# tail is <1% at any real head_dim); causal masking is NOT discounted so
+# the number matches the dense attention it replaces (MFU convention:
+# model FLOPs, not grid-cell recompute).
+
+def _flash_dims(in_avals):
+    q, k = in_avals[0], in_avals[1]
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    return int(bh), int(sq), int(sk), int(d)
+
+
+def _flash_fwd_cost(in_avals, out_avals):
+    from .registry import KernelCost
+
+    bh, sq, sk, d = _flash_dims(in_avals)
+    # QK^T and PV: 2 contractions of 2*sq*sk*d each, per batch*head slab
+    return KernelCost(flops=4.0 * bh * sq * sk * d,
+                      bytes=io_bytes(in_avals, out_avals))
+
+
+def _flash_bwd_dq_cost(in_avals, out_avals):
+    from .registry import KernelCost
+
+    bh, sq, sk, d = _flash_dims(in_avals)
+    # recomputed scores + dp + dq accumulation: 3 contractions
+    return KernelCost(flops=6.0 * bh * sq * sk * d,
+                      bytes=io_bytes(in_avals, out_avals))
+
+
+def _flash_bwd_dkv_cost(in_avals, out_avals):
+    from .registry import KernelCost
+
+    bh, sq, sk, d = _flash_dims(in_avals)
+    # recomputed scores + dp + dv + dk accumulations: 4 contractions
+    return KernelCost(flops=8.0 * bh * sq * sk * d,
+                      bytes=io_bytes(in_avals, out_avals))
+
+
+register_kernel(
+    "flash_fwd", _flash_fwd_cost, module=__name__,
+    doc="blocked online-softmax attention forward (o, lse)")
+register_kernel(
+    "flash_bwd_dq", _flash_bwd_dq_cost, module=__name__,
+    doc="flash attention backward: dq accumulated over key blocks")
+register_kernel(
+    "flash_bwd_dkv", _flash_bwd_dkv_cost, module=__name__,
+    doc="flash attention backward: dk/dv accumulated over query blocks")

@@ -150,11 +150,34 @@ class Test(Optimizer):
 
 @OPTIMIZERS.register("adam")
 class Adam(Optimizer):
-    """Adam (capability extension; reference v0.5 ships only SGD)."""
+    """Adam (capability extension; reference v0.5 ships only SGD).
 
-    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, lr=0.001, **kwargs):
+    ``fused``: route the pure pytree path (``apply``) through the ONE
+    blocked Pallas kernel (ops/pallas/adam.py) instead of the per-leaf
+    elementwise tree — bitwise-identical results, same
+    ``{name: (m, v, t)}`` state layout (checkpoints interchange freely);
+    step-time delta measured per rig by ``bench.py --kernel-bench``.
+    None (default) reads the env gate ``MXNET_TPU_FUSED_ADAM``; the
+    imperative KVStore path is unaffected.
+    """
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, lr=0.001,
+                 fused=None, **kwargs):
         super().__init__(lr=lr, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.fused = fused
+
+    def _fused_active(self) -> bool:
+        from .ops.pallas.adam import fused_resolve
+
+        return fused_resolve(self.fused)
+
+    def apply(self, params, grads, states, lr):
+        if self._fused_active():
+            from .ops.pallas.adam import fused_adam_apply
+
+            return fused_adam_apply(self, params, grads, states, lr)
+        return super().apply(params, grads, states, lr)
 
     def create_state(self, index, weight):
         # per-parameter step counter (a shared one would corrupt the bias
@@ -233,6 +256,12 @@ class AdamW(Adam):
             self.weight_decay = wd
 
     def apply(self, params, grads, states, lr):
+        if self._fused_active():
+            # the fused kernel masks the decay per tile (decay_filter is
+            # trace-time static), so it handles both filter cases
+            from .ops.pallas.adam import fused_adam_apply
+
+            return fused_adam_apply(self, params, grads, states, lr)
         if self.decay_filter is None:
             return super().apply(params, grads, states, lr)
         wd, new_p, new_s = self.weight_decay, {}, {}

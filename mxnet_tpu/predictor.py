@@ -32,7 +32,7 @@ class Predictor:
     """Forward-only bound model (reference: MXPredCreate/Forward/GetOutput)."""
 
     def __init__(self, symbol, arg_params, aux_params=None, ctx=None,
-                 input_names=("data",), compute_dtype=None):
+                 input_names=("data",), compute_dtype=None, quantize=None):
         if isinstance(symbol, str):
             symbol = sym_mod.load_json(symbol) if symbol.lstrip().startswith("{") \
                 else sym_mod.load(symbol)
@@ -40,6 +40,15 @@ class Predictor:
         self.ctx = ctx or cpu()
         self.input_names = list(input_names)
         self.compute_dtype = compute_dtype
+        # quantize="int8": serve FullyConnected matmuls through the int8
+        # Pallas kernel (per-channel weight scales, f32 accumulate; see
+        # ops/pallas/matmul.py). The gate is trace-time, so forward()
+        # wraps the jit dispatch in the scope — the first call traces the
+        # quantized program, later calls reuse it.
+        if quantize not in (None, False, "int8"):
+            raise MXNetError(f"Predictor quantize= must be None or 'int8', "
+                             f"got {quantize!r}")
+        self.quantize = quantize or None
         dev = self.ctx.jax_device
         self._params = {k: jax.device_put(np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v), dev)
                         for k, v in arg_params.items()}
@@ -82,8 +91,15 @@ class Predictor:
         for k, v in inputs.items():
             self.set_input(k, v)
         missing = self._fill_labels()
-        self._outputs = self._fwd(self._params, self._aux,
-                                  {**self._inputs, **missing})
+        if self.quantize == "int8":
+            from .ops.pallas.matmul import int8_predict_scope
+
+            with int8_predict_scope():
+                self._outputs = self._fwd(self._params, self._aux,
+                                          {**self._inputs, **missing})
+        else:
+            self._outputs = self._fwd(self._params, self._aux,
+                                      {**self._inputs, **missing})
         return self
 
     def _fill_labels(self):

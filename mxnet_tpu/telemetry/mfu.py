@@ -20,6 +20,14 @@ compute slightly more (recomputed remat blocks) or fewer (algebraic
 simplification). On CPU rigs the measured peak makes MFU a rig-relative
 ratio, not a datasheet fraction.
 
+Custom Pallas kernels (flash attention, the fused comm/optimizer
+kernels) are priced through the kernel registry
+(ops/pallas/registry.py): the audit attributes each registered
+``pallas_call`` from its FLOP model instead of recursing into one grid
+cell — before the registry, a flash-attention transformer's MFU
+under-reported by the whole attention FLOP count
+(doc/developer-guide/kernels.md).
+
 **Goodput** = fraction of wall time spent on steps that advanced
 training. The badput side is attributed from the registries that already
 know: XLA compile seconds (compile registry delta), non-finite skipped

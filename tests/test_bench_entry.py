@@ -261,6 +261,54 @@ def test_bench_lockwatch_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_LOCKWATCH_*
 
 
+def test_bench_kernel_smoke_json_contract():
+    """--kernel-bench --smoke is the CI guard on the Pallas kernel-layer
+    bench (ISSUE 13): one JSON line with the contract keys, a roofline
+    row per kernel (registry FLOP/byte model + measured interpret-mode
+    time), the fused-vs-unfused HLO acceptance — the kernel path removes
+    EVERY full-slab quantize pass while moving byte-identical
+    collectives — and fused-Adam bitwise parity."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--kernel-bench",
+         "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "kernels",
+                "hlo_fused_vs_unfused", "wire_bytes_identical",
+                "fused_adam", "int8_matmul_rel_error", "catalog"):
+        assert key in blob, blob
+    assert blob["metric"] == "kernel_bench_full_slab_quantize_passes_removed"
+    # ACCEPTANCE: the codec path runs full-slab quantize passes, the
+    # kernel path runs none, and the wire bytes are identical
+    hlo = blob["hlo_fused_vs_unfused"]
+    assert hlo["codec"]["full_slab_quantize_passes"] > 0, blob
+    assert hlo["kernels"]["full_slab_quantize_passes"] == 0, blob
+    assert blob["value"] == hlo["codec"]["full_slab_quantize_passes"]
+    assert blob["wire_bytes_identical"] is True, blob
+    # a roofline row per kernel family, each priced by the registry
+    row_names = {k["kernel"] for k in blob["kernels"]}
+    assert {"flash_attention_fwd", "flash_attention_fwd_bwd", "quant_int8",
+            "quant_twobit", "dequant_sum_int8", "fused_adam",
+            "int8_matmul"} <= row_names
+    for row in blob["kernels"]:
+        assert row["model_flops"] > 0 and row["model_bytes"] > 0, row
+        assert row["ms"] > 0 and row["achieved_gflops_s"] > 0, row
+        assert row["kernels_in_program"], row
+    # ACCEPTANCE: fused sharded-Adam step-time row + exact parity
+    assert blob["fused_adam"]["bitwise_parity"] is True, blob
+    assert blob["fused_adam"]["fused_ms"] > 0
+    assert blob["fused_adam"]["per_leaf_ms"] > 0
+    assert 0 < blob["int8_matmul_rel_error"] < 0.02, blob
+    # the catalog covers every registered kernel
+    assert {c["kernel"] for c in blob["catalog"]} >= {
+        "flash_fwd", "fused_adam", "quant_int8", "int8_matmul"}
+    assert blob["smoke"] is True  # smoke runs never write BENCH_KERNELS_*
+
+
 @pytest.mark.slow
 def test_bench_pipeline_mode_json_contract(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")

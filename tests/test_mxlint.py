@@ -717,6 +717,74 @@ def test_tree_has_no_mx307_findings():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+# -- MX312 pallas-kernel-discipline fixtures (ISSUE 13) ------------------------
+
+def test_fixture_mx312_pallas_call_outside_layer():
+    src = (
+        "from jax.experimental import pallas as pl\n"
+        "def hot(x):\n"
+        "    return pl.pallas_call(k, out_shape=o)(x)\n"
+        "def hotter(x):\n"
+        "    return pl.pallas_call(k2, out_shape=o)(x)\n"
+    )
+    findings = lint_source(src, "mxnet_tpu/models/fastnet.py")
+    assert [f.rule.id for f in findings] == ["MX312", "MX312"]
+    assert [f.line for f in findings] == [3, 5]
+
+
+def test_fixture_mx312_kernel_module_missing_registry_entry():
+    # inside the layer but unpriced: ONE finding per module, at the
+    # first pallas_call
+    src = (
+        "from jax.experimental import pallas as pl\n"
+        "def my_kernel(x):\n"
+        "    return pl.pallas_call(k, out_shape=o, name='my_kernel')(x)\n"
+        "def my_kernel2(x):\n"
+        "    return pl.pallas_call(k2, out_shape=o, name='my_kernel2')(x)\n"
+    )
+    findings = lint_source(src, "mxnet_tpu/ops/pallas/newkern.py")
+    assert [f.rule.id for f in findings] == ["MX312"]
+    assert findings[0].line == 3
+    assert "register" in findings[0].message
+
+
+def test_fixture_mx312_registered_kernel_module_clean():
+    src = (
+        "from jax.experimental import pallas as pl\n"
+        "from .registry import register_kernel\n"
+        "def my_kernel(x):\n"
+        "    return pl.pallas_call(k, out_shape=o, name='my_kernel')(x)\n"
+        "register_kernel('my_kernel', cost_fn)\n"
+    )
+    assert [f.rule.id for f in
+            lint_source(src, "mxnet_tpu/ops/pallas/newkern.py")] == []
+    # modules that never emit a pallas_call owe the registry nothing
+    assert lint_source("def f(x):\n    return x\n",
+                       "mxnet_tpu/ops/pallas/helpers.py") == []
+
+
+def test_fixture_mx312_pragma_escape_hatch():
+    src = (
+        "from jax.experimental import pallas as pl\n"
+        "def hot(x):\n"
+        "    return pl.pallas_call(k, out_shape=o)(x)"
+        "  # mxlint: disable=MX312 - vendored prototype\n"
+    )
+    assert [f.rule.id for f in
+            lint_source(src, "mxnet_tpu/models/fastnet.py")] == []
+
+
+def test_self_lint_mx312_clean():
+    """The kernel layer itself passes its own discipline: every module
+    emitting a pallas_call registers a cost model, and no pallas_call
+    lives outside ops/pallas/."""
+    from mxnet_tpu.analysis.source_lint import lint_paths
+
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX312"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 # -- MX308 unpinned-wire-collective fixtures (ISSUE 7 satellite) ---------------
 
 def test_fixture_mx308_unpinned_collective():
