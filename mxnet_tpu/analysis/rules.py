@@ -247,6 +247,21 @@ register_rule(
     "a justification")
 
 register_rule(
+    "MX313", "warning",
+    "per-leaf Python loop over a gradient pytree inside a traced "
+    "function that materializes per-leaf host statistics: each "
+    "`float(...)`/`.item()`/numpy call inside the loop blocks the host "
+    "on a device round-trip per parameter per step — the pattern the "
+    "in-graph health stats engine (telemetry.health, ISSUE 14) replaces "
+    "with ONE fused per-layer reduction pass and a single tiny pull",
+    "compute the statistics inside the step program — fit(health=True) "
+    "gives per-layer grad/weight/update norms + nonfinite counts on "
+    "device (telemetry.health.device_stats for custom stats) — and pull "
+    "one stacked vector after the step retires; a deliberate host-side "
+    "per-leaf loop (debug tooling) carries `# mxlint: disable=MX313` "
+    "with a justification")
+
+register_rule(
     "MX306", "warning",
     "un-barriered wall-clock delta around device dispatch: a "
     "time.time()/perf_counter() start/stop pair with work between and no "

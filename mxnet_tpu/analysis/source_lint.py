@@ -27,6 +27,11 @@ Catches, before anything imports or traces:
                request_world, set_gradient_compression) outside
                resilience/controller.py — actuation must flow through
                the FleetController policy loop and its safety rails,
+  MX313        per-leaf Python loops over gradient pytrees inside traced
+               functions that materialize per-leaf host stats (float()/
+               .item()/numpy per parameter per step) — the pattern the
+               in-graph health stats engine (telemetry.health) replaces
+               with one fused per-layer reduction + a single pull,
   MX601-602    robustness hazards (bare ``except:``; ``while True`` retry
                loops that swallow exceptions with no backoff/deadline —
                the loop shape that melts a parameter server under a
@@ -439,6 +444,44 @@ class _TracedWalk(ast.NodeVisitor):
         if self._test_touches_param(node.test):
             self._flag("MX203", "Python `while` on a function argument "
                        "that may be traced", node)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        # MX313: a per-leaf loop over a gradient pytree whose body pulls
+        # host values (float()/int(), .item()/.tolist()/.asnumpy(),
+        # numpy.*) — per-parameter host round-trips every step, the shape
+        # the in-graph health stats engine replaces. One finding per loop;
+        # pure-jnp per-leaf loops (unrolled at trace) stay clean.
+        if _mentions_grad(node.iter):
+            hit = None
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    f = sub.func
+                    dotted = _dotted(f, self.scan.imports)
+                    if dotted is not None and (
+                            dotted == "numpy"
+                            or dotted.startswith("numpy.")):
+                        hit = sub
+                    elif isinstance(f, ast.Attribute) and not sub.args \
+                            and f.attr in ("item", "tolist", "asnumpy"):
+                        hit = sub
+                    elif isinstance(f, ast.Name) and sub.args \
+                            and f.id in ("float", "int"):
+                        hit = sub
+                    if hit is not None:
+                        break
+                if hit is not None:
+                    break
+            if hit is not None:
+                self._flag(
+                    "MX313",
+                    "per-leaf loop over a gradient pytree materializes "
+                    "host statistics inside traced code (one device "
+                    "round-trip per parameter per step); the in-graph "
+                    "health stats engine computes these fused on device",
+                    hit)
         self.generic_visit(node)
 
     def visit_JoinedStr(self, node):

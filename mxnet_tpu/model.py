@@ -375,6 +375,7 @@ class FeedForward(BASE_ESTIMATOR):
         # model state
         state.pop("telemetry", None)
         state.pop("_active_timeline", None)
+        state.pop("health_monitor", None)
         return state
 
     def __setstate__(self, state):
@@ -499,7 +500,7 @@ class FeedForward(BASE_ESTIMATOR):
     def _get_train_step(self, bucket_key, data_names, label_names, optimizer,
                         mesh, metric=None, apply_update=True, guard_cfg=None,
                         pad_policy=None, compression=None, overlap_plan=None,
-                        comm_kernels=None):
+                        comm_kernels=None, health_cfg=None):
         """The fused train step for one program configuration, built once
         and cached on the instance (reference analog: GraphExecutor's
         cached engine ops, one per shape). precompile() populates the same
@@ -512,6 +513,7 @@ class FeedForward(BASE_ESTIMATOR):
                None if compression is None else compression.key(),
                None if overlap_plan is None else overlap_plan.layout_key(),
                None if comm_kernels is None else comm_kernels.key(),
+               None if health_cfg is None else health_cfg.key(),
                str(self.compute_dtype))
         if key not in self._train_fns:
             warmed = sum(getattr(fn, "_tracked", None) is not None
@@ -534,13 +536,14 @@ class FeedForward(BASE_ESTIMATOR):
                 apply_update=apply_update, guard_cfg=guard_cfg,
                 pad_policy=pad_policy, compression=compression,
                 overlap_plan=overlap_plan, comm_kernels=comm_kernels,
-                label=label)
+                health_cfg=health_cfg, label=label)
         return self._train_fns[key]
 
     def _build_train_step(self, data_names, label_names, optimizer, mesh,
                           symbol=None, metric_update=None, apply_update=True,
                           guard_cfg=None, pad_policy=None, compression=None,
-                          overlap_plan=None, comm_kernels=None, label=None):
+                          overlap_plan=None, comm_kernels=None,
+                          health_cfg=None, label=None):
         """Compile the fused train step.
 
         With ``guard_cfg`` (resilience.GuardConfig) the program additionally
@@ -575,10 +578,51 @@ class FeedForward(BASE_ESTIMATOR):
         XLA can hide each bucket's wire time under the rest of backward;
         the comm state becomes a dict of per-bucket residual ledgers
         (doc/developer-guide/comm.md, "Overlap scheduler").
+
+        With ``health_cfg`` (telemetry.HealthConfig) the step additionally
+        computes per-layer gradient/weight/update statistics + nonfinite
+        counts ON DEVICE (telemetry.health.device_stats) and threads the
+        resulting tiny pytree through the donated carry exactly like the
+        guard state — fixed shapes, so the armed zero-recompile epoch
+        stays green, and the stats live in the same XLA program, so the
+        jaxpr-audit FLOP table prices them and MFU stays honest. On the
+        compressed shard_map path the stats read the post-allreduce
+        (replicated) gradients — what the optimizer really consumed — so
+        no extra collective crosses the wire.
         """
-        graph_fn = _build_graph_fn(symbol if symbol is not None else self.symbol,
-                                   is_train=True)
+        symbol = symbol if symbol is not None else self.symbol
+        graph_fn = _build_graph_fn(symbol, is_train=True)
         compute_dtype = self.compute_dtype
+        health_groups = None
+        health_heads = ()
+        if health_cfg is not None:
+            # layer groups derive from the SAME base the fit loop's host
+            # side uses (symbol arguments minus inputs == param_names), so
+            # the (L,) stat vectors index identically on both sides
+            inputs = set(data_names) | set(label_names)
+            health_groups = telemetry_mod.health.layer_groups(
+                n for n in symbol.list_arguments() if n not in inputs)
+            # loss heads + their label inputs: the TRUE scalar loss for
+            # the health stream. The seed-ones cotangent reduced below is
+            # a gradient seed — for softmax heads it is CONSTANT (the
+            # outputs are probabilities), useless to a spike detector.
+            health_heads = tuple(
+                (i, node.op, node.inputs[1][0].name)
+                for i, (node, _k) in enumerate(symbol._heads)
+                if not node.is_variable
+                and getattr(node.op, "is_loss", False)
+                and len(node.inputs) > 1 and node.inputs[1][0].is_variable)
+
+        def _health_loss_value(outs, batch, mask):
+            total = None
+            for i, op, lbl in health_heads:
+                if lbl not in batch:
+                    continue
+                lv = op.loss_value(outs[i], batch[lbl], mask=mask)
+                if lv is None:
+                    continue
+                total = lv if total is None else total + lv
+            return total
         comm_spec = compression if mesh is not None else None
         in_shard = comm_spec is not None  # compute body runs inside shard_map
         axis_size = int(mesh.shape["dp"]) if mesh is not None else 1
@@ -589,7 +633,7 @@ class FeedForward(BASE_ESTIMATOR):
         comm_kernels = comm_kernels if comm_kernels is not None else False
 
         def compute(params, opt_state, aux, batch, rng, lr, mstate, gstate,
-                    valid, cstate=None):
+                    valid, cstate=None, hstate=None):
             from . import comm as comm_mod
 
             scale = gstate["scale"] if guard_cfg is not None else None
@@ -649,6 +693,17 @@ class FeedForward(BASE_ESTIMATOR):
                 new_aux = jax.tree_util.tree_map(
                     lambda a: jax.lax.pmean(a, "dp")
                     if jnp.issubdtype(a.dtype, jnp.floating) else a, new_aux)
+            h_loss = None
+            if health_cfg is not None:
+                # true training loss while the head outputs are still in
+                # hand (the metric fold below drops them)
+                h_loss = _health_loss_value(outs, batch, mask)
+                if h_loss is None:
+                    # no loss head priced itself: the seed scalar is the
+                    # only signal left (already psum'd on the shard path)
+                    h_loss = loss if scale is None else loss / scale
+                elif in_shard:
+                    h_loss = jax.lax.psum(h_loss, "dp")
             finite = None
             if guard_cfg is not None and guard_cfg.skip_nonfinite:
                 # scaled loss + unscaled grads: overflow in either shows up
@@ -703,42 +758,51 @@ class FeedForward(BASE_ESTIMATOR):
                 gstate = guards_mod.update_guard_state(
                     guard_cfg, gstate,
                     finite if finite is not None else jnp.bool_(True))
+            new_hstate = hstate
+            if health_cfg is not None:
+                # per-layer stats from the grads the optimizer consumed
+                # (replicated post-allreduce on the shard path — already
+                # global, nothing extra crosses the wire) and the
+                # post-guard-select params: a skipped step reads as
+                # update_ratio 0 while its grad norms still show the
+                # explosion that tripped the guard
+                new_hstate = telemetry_mod.health.device_stats(
+                    health_groups, params, grads, new_params, h_loss)
             return (new_params, new_opt_state, new_aux, outs, mstate, gstate,
-                    new_cstate)
+                    new_cstate, new_hstate)
 
-        # signature tail: [gstate][cstate][valid] — donated indices stay
-        # fixed for the existing configurations; ``valid`` (a scalar) is
-        # never donated
+        # signature tail: [gstate][cstate][hstate][valid] — donated indices
+        # stay fixed for the existing configurations; ``valid`` (a scalar)
+        # is never donated
         padded = pad_policy is not None
+        has_g = guard_cfg is not None
+        has_h = health_cfg is not None
         if in_shard:
             return self._finish_sharded_step(
                 compute, mesh, comm_spec, axis_size, guard_cfg, has_cstate,
-                padded, label, overlap_plan=overlap_plan)
-        if guard_cfg is None:
-            if padded:
-                def step(params, opt_state, aux, batch, rng, lr, mstate,
-                         valid):
-                    return compute(params, opt_state, aux, batch, rng, lr,
-                                   mstate, None, valid)[:5]
-            else:
-                def step(params, opt_state, aux, batch, rng, lr, mstate):
-                    return compute(params, opt_state, aux, batch, rng, lr,
-                                   mstate, None, None)[:5]
+                padded, label, overlap_plan=overlap_plan, has_health=has_h)
 
-            donate = (0, 1, 2, 6)
-        else:
+        def step(params, opt_state, aux, batch, rng, lr, mstate, *rest):
+            i = 0
+            gstate = hstate = valid = None
+            if has_g:
+                gstate = rest[i]
+                i += 1
+            if has_h:
+                hstate = rest[i]
+                i += 1
             if padded:
-                def step(params, opt_state, aux, batch, rng, lr, mstate,
-                         gstate, valid):
-                    return compute(params, opt_state, aux, batch, rng, lr,
-                                   mstate, gstate, valid)[:6]
-            else:
-                def step(params, opt_state, aux, batch, rng, lr, mstate,
-                         gstate):
-                    return compute(params, opt_state, aux, batch, rng, lr,
-                                   mstate, gstate, None)[:6]
+                valid = rest[i]
+            res = compute(params, opt_state, aux, batch, rng, lr, mstate,
+                          gstate, valid, None, hstate)
+            out = res[:5]
+            if has_g:
+                out += (res[5],)
+            if has_h:
+                out += (res[7],)
+            return out
 
-            donate = (0, 1, 2, 6, 7)
+        donate = (0, 1, 2, 6) + tuple(7 + j for j in range(has_g + has_h))
 
         if mesh is None:
             # Single-device path: pin everything to the ctx device. Data
@@ -805,7 +869,7 @@ class FeedForward(BASE_ESTIMATOR):
 
     def _finish_sharded_step(self, compute, mesh, comm_spec, axis_size,
                              guard_cfg, has_cstate, padded, label,
-                             overlap_plan=None):
+                             overlap_plan=None, has_health=False):
         """Assemble the compressed-comm train step: ``jit(shard_map(...))``
         over the dp axis (see _build_train_step's compression note).
 
@@ -819,35 +883,42 @@ class FeedForward(BASE_ESTIMATOR):
         from .compat import shard_map as _shard_map
 
         has_g = guard_cfg is not None
+        has_h = has_health
 
         def step(params, opt_state, aux, batch, rng, lr, mstate, *rest):
             i = 0
-            gstate = cstate = valid = None
+            gstate = cstate = hstate = valid = None
             if has_g:
                 gstate = rest[i]
                 i += 1
             if has_cstate:
                 cstate = rest[i]
                 i += 1
+            if has_h:
+                hstate = rest[i]
+                i += 1
             if padded:
                 valid = rest[i]
             res = compute(params, opt_state, aux, batch, rng, lr, mstate,
-                          gstate, valid, cstate)
+                          gstate, valid, cstate, hstate)
             out = res[:5]
             if has_g:
                 out += (res[5],)
             if has_cstate:
                 out += (res[6],)
+            if has_h:
+                out += (res[7],)
             return out
 
-        tail_in = (P(),) * has_g + (P("dp"),) * has_cstate + (P(),) * padded
+        tail_in = (P(),) * has_g + (P("dp"),) * has_cstate \
+            + (P(),) * has_h + (P(),) * padded
         in_specs = (P(), P(), P(), P("dp"), P(), P(), P()) + tail_in
         out_specs = (P(), P(), P(), P("dp"), P()) \
-            + (P(),) * has_g + (P("dp"),) * has_cstate
+            + (P(),) * has_g + (P("dp"),) * has_cstate + (P(),) * has_h
         sharded = _shard_map(step, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
-        donate = (0, 1, 2, 6) + tuple(7 + j
-                                      for j in range(has_g + has_cstate))
+        donate = (0, 1, 2, 6) + tuple(
+            7 + j for j in range(has_g + has_cstate + has_h))
         jitted = compile_mod.tracked_jit(sharded, label=label,
                                          donate_argnums=donate)
         repl = NamedSharding(mesh, P())
@@ -883,6 +954,9 @@ class FeedForward(BASE_ESTIMATOR):
                 if _needs_place(c, mesh):
                     c = jax.tree_util.tree_map(lambda v: _place(v, csh), c)
                 placed.append(c)
+            if has_h:
+                placed.append(place_repl(rest[i]))
+                i += 1
             if padded:
                 placed.append(_place(jnp.asarray(rest[i]), repl))
             return jitted(params, opt_state, aux, batch, rng,
@@ -921,7 +995,7 @@ class FeedForward(BASE_ESTIMATOR):
             logger=None, work_load_list=None, batch_size=128,
             sharded_checkpoint_dir=None, guards=None, pad_policy=None,
             compression=None, overlap=None, comm_kernels=None,
-            telemetry=None, elastic=None, controller=None):
+            telemetry=None, elastic=None, controller=None, health=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -1036,9 +1110,23 @@ class FeedForward(BASE_ESTIMATOR):
         Every decision is a ``controller`` event + flight-recorder
         incident; its own circuit breaker freezes actuation (never the
         fit) on failures or goodput regressions
-        (doc/developer-guide/resilience.md, "Fleet controller")."""
+        (doc/developer-guide/resilience.md, "Fleet controller").
+
+        ``health``: training-health observability — None (default; env
+        gate ``MXNET_TPU_HEALTH``), True, or a telemetry.HealthConfig.
+        When armed, the fused step computes per-layer gradient norm,
+        weight norm, update:weight ratio, and nonfinite counts ON DEVICE
+        (donated through the step carry — zero-recompile invariant
+        preserved, stats priced into the MFU FLOP table), and a streaming
+        HealthMonitor (``self.health_monitor``) runs EWMA/MAD anomaly
+        detectors on the host: loss spikes, per-layer gradient
+        explosions, dead layers, slow divergence drift, NaN/Inf — each
+        hit a ``health_anomaly`` flight-recorder incident naming the
+        layer, emitted BEFORE the guard-skip event it explains
+        (doc/developer-guide/telemetry.md, "Training health")."""
         del work_load_list
         guard_cfg = guards_mod.GuardConfig.resolve(guards)
+        health_cfg = telemetry_mod.HealthConfig.resolve(health)
         pad_policy = compile_mod.PadPolicy.resolve(pad_policy)
         tcfg = telemetry_mod.TelemetryConfig.resolve(telemetry)
         from . import comm as comm_mod
@@ -1300,6 +1388,24 @@ class FeedForward(BASE_ESTIMATOR):
         cstate, resid_layout_key = _build_comm_state(resume_comm_state,
                                                      resume_comm_layout)
 
+        # -- training health (ISSUE 14): in-jit per-layer stats + the
+        # streaming anomaly monitor consuming them as a hub sink ----------
+        if health_cfg is not None and async_kv:
+            logger.info("health= ignored with kvstore='dist_async': the "
+                        "worker step carries grads, not updates — the "
+                        "update:weight ratio has no in-step meaning")
+            health_cfg = None
+        health_groups = None
+        hstate = None
+        hmon = None
+        if health_cfg is not None:
+            health_groups = telemetry_mod.health.layer_groups(param_names)
+            hstate = telemetry_mod.health.init_device_stats(health_groups)
+            hmon = telemetry_mod.HealthMonitor(health_cfg).attach()
+            self.health_monitor = hmon
+            logger.info("health: per-layer stats in-jit over %d layer(s) "
+                        "(%r)", len(health_groups), health_cfg)
+
         # -- fleet controller (ISSUE 12): the policy loop closing the
         # telemetry -> actuation gap (doc/developer-guide/resilience.md,
         # "Fleet controller"). Membership levers actuate through the
@@ -1320,7 +1426,7 @@ class FeedForward(BASE_ESTIMATOR):
                 fp32_wire_bytes=comm_mod.fp32_allreduce_wire_bytes(
                     comm_mod.flat_size(params), ndev_now)
                 if mesh is not None else 0.0,
-                logger=logger)
+                health=hmon, logger=logger)
             logger.info("controller: %s (%r)", fleet_ctl.state,
                         fleet_ctl.cfg)
 
@@ -1535,7 +1641,8 @@ class FeedForward(BASE_ESTIMATOR):
             whole downtime lands in the timeline as a coordinator span
             (kind="resize") and in goodput as ``resize`` badput."""
             nonlocal mesh, params, opt_state, aux, gstate, cstate, \
-                resid_layout_key, overlap_plan, num_update, _place_batch
+                resid_layout_key, overlap_plan, num_update, _place_batch, \
+                hstate
             from .utils import checkpoint as ckpt_mod
 
             t0 = time.time()
@@ -1582,6 +1689,11 @@ class FeedForward(BASE_ESTIMATOR):
                     overlap_plan = overlap_plan.replan(int(mesh.shape["dp"]))
                 cstate, resid_layout_key = _build_comm_state(
                     comm_saved, meta.get("comm_layout"))
+                if health_cfg is not None:
+                    # stats are per-step; a fresh zero carry placed on the
+                    # NEW mesh is the correct post-resize state
+                    hstate = telemetry_mod.health.init_device_stats(
+                        health_groups)
                 train_steps.clear()
                 _place_batch = _make_place_batch(mesh)
                 if mfu_acct is not None:
@@ -1604,7 +1716,8 @@ class FeedForward(BASE_ESTIMATOR):
                     else False,
                     comm_kernels=kern_cfg if kern_cfg is not None
                     else False,
-                    batch_end_callback=batch_end_callback)
+                    batch_end_callback=batch_end_callback,
+                    health=health_cfg if health_cfg is not None else False)
             finally:
                 if rspan is not None:
                     rspan.end()
@@ -1661,7 +1774,8 @@ class FeedForward(BASE_ESTIMATOR):
                     else False,
                     comm_kernels=kern_cfg if kern_cfg is not None
                     else False,
-                    batch_end_callback=batch_end_callback)
+                    batch_end_callback=batch_end_callback,
+                    health=health_cfg if health_cfg is not None else False)
                 fleet_ctl.retier_applied(action, time.time() - t0)
                 logger.info(
                     "controller: compression re-tiered to %s%s in %.2fs "
@@ -1775,7 +1889,7 @@ class FeedForward(BASE_ESTIMATOR):
                             guard_cfg=guard_cfg, pad_policy=pad_policy,
                             compression=comm_spec,
                             overlap_plan=overlap_plan,
-                            comm_kernels=kern_cfg)
+                            comm_kernels=kern_cfg, health_cfg=health_cfg)
                     train_step = train_steps[bkey]
                     pad_tail = ()
                     if pad_policy is not None:
@@ -1792,18 +1906,22 @@ class FeedForward(BASE_ESTIMATOR):
                         mfu_tail = () if guard_cfg is None else (gstate,)
                         if cstate is not None:
                             mfu_tail += (cstate,)
+                        if hstate is not None:
+                            mfu_tail += (hstate,)
                         mfu_acct.maybe_trace(
                             train_step._tracked._jitted,
                             (params, opt_state, aux, batch_arrays, rng,
                              jnp.float32(lr), maccum.state) + mfu_tail
                             + pad_tail)
                     # state tail mirrors the step signature:
-                    # [gstate][cstate][valid]
+                    # [gstate][cstate][hstate][valid]
+                    hs_tail = () if hstate is None else (hstate,)
                     if guard_cfg is None:
                         tail = () if cstate is None else (cstate,)
                         res = train_step(params, opt_state, aux,
                                          batch_arrays, rng, lr,
-                                         maccum.state, *tail, *pad_tail)
+                                         maccum.state, *tail, *hs_tail,
+                                         *pad_tail)
                     else:
                         batch_arrays = self._chaos_step_sites(
                             batch_arrays, b_dnames, watchdog)
@@ -1819,7 +1937,8 @@ class FeedForward(BASE_ESTIMATOR):
                                     else (gstate, cstate)
                                 res = train_step(
                                     params, opt_state, aux, batch_arrays,
-                                    rng, lr, maccum.state, *tail, *pad_tail)
+                                    rng, lr, maccum.state, *tail, *hs_tail,
+                                    *pad_tail)
                                 break
                             except chaos_mod.TransientStepError:
                                 if retries <= 0:
@@ -1855,6 +1974,32 @@ class FeedForward(BASE_ESTIMATOR):
                         idx += 1
                     if cstate is not None:
                         cstate = res[idx]
+                        idx += 1
+                    if hstate is not None:
+                        hstate = res[idx]
+                        if nbatch % health_cfg.every == 0:
+                            # pull the tiny stat vectors + emit the health
+                            # event; the monitor's detectors run inside
+                            # the emit, so any health_anomaly lands in the
+                            # flight ring BEFORE the guard-skip event that
+                            # closes the story
+                            _, h_finite = \
+                                telemetry_mod.health.observe_device_stats(
+                                    health_groups, hstate, epoch, nbatch)
+                            # only a guard that actually skips gets the
+                            # skip event — with skip_nonfinite=False the
+                            # poisoned update was APPLIED, and a post-
+                            # mortem must not read a skip that never ran
+                            if guard_cfg is not None and \
+                                    guard_cfg.skip_nonfinite and \
+                                    not h_finite:
+                                if span is not None:
+                                    span.event("guard_skip")
+                                else:
+                                    telemetry_mod.emit(
+                                        "step_event", span_kind="step",
+                                        epoch=epoch, step=nbatch,
+                                        name="guard_skip")
                     step_finite = True
                     if guard_cfg is not None and (async_kv
                                                   or not use_device_metric):
@@ -2100,6 +2245,8 @@ class FeedForward(BASE_ESTIMATOR):
                 preempt_mod.PreemptionHandler.uninstall()
             if fleet_ctl is not None:
                 fleet_ctl.unbind()
+            if hmon is not None:
+                hmon.detach()
             if elastic_co is not None:
                 telemetry_mod.set_world(*elastic_prev_world)
             # a mid-step exception (preemption, retry exhaustion) can leave
@@ -2121,7 +2268,7 @@ class FeedForward(BASE_ESTIMATOR):
                    eval_metric="accuracy", kvstore="local", guards=None,
                    pad_policy=None, compression=None, overlap=None,
                    comm_kernels=None, batch_end_callback=None,
-                   parallel=True):
+                   health=None, parallel=True):
         """AOT warmup: compile every fused train program ``fit`` would need
         BEFORE training, via ``.lower().compile()`` — so step 1 of each
         shape dispatches a ready executable instead of stalling on XLA
@@ -2172,6 +2319,7 @@ class FeedForward(BASE_ESTIMATOR):
 
         guard_cfg = guards_mod.GuardConfig.resolve(guards)
         pad_policy = compile_mod.PadPolicy.resolve(pad_policy)
+        health_cfg = telemetry_mod.HealthConfig.resolve(health)
         from . import comm as comm_mod
 
         comm_spec = comm_mod.CompressionSpec.resolve(compression)
@@ -2238,7 +2386,8 @@ class FeedForward(BASE_ESTIMATOR):
                 metric=metric if use_device_metric else None,
                 apply_update=True, guard_cfg=guard_cfg,
                 pad_policy=pad_policy, compression=comm_spec,
-                overlap_plan=overlap_plan, comm_kernels=kern_cfg)
+                overlap_plan=overlap_plan, comm_kernels=kern_cfg,
+                health_cfg=health_cfg)
             batch_s = {}
             for name, spec in {**d, **l}.items():
                 shape, dtype = _split(spec)
@@ -2263,6 +2412,11 @@ class FeedForward(BASE_ESTIMATOR):
                                             np.dtype(np.float32),
                                             sharded=True)},)
                 ef_resid_struct = args[-1]["resid"]
+            if health_cfg is not None:
+                groups = telemetry_mod.health.layer_groups(param_names)
+                hs = telemetry_mod.health.init_device_stats(groups)
+                args += (jax.tree_util.tree_map(
+                    lambda x: _sds(tuple(x.shape), np.dtype(x.dtype)), hs),)
             if pad_policy is not None:
                 args += (_sds((), np.dtype(np.int32)),)
             jobs.append((step._tracked, args))

@@ -22,11 +22,25 @@ __all__ = ["Monitor", "nonfinite_count"]
 
 
 def nonfinite_count(x) -> int:
-    """Number of NaN/Inf elements in an array (0 for non-float dtypes)."""
-    x = np.asarray(x)
-    if not np.issubdtype(x.dtype, np.floating):
+    """Number of NaN/Inf elements in an array (0 for non-float dtypes).
+
+    Device arrays are counted ON DEVICE: the reduction runs where the
+    data lives and only the one scalar crosses to host — the old
+    ``np.asarray(x)`` pulled the whole slab over the wire per call (a
+    full activation/weight tensor per monitored stat on a remote TPU)."""
+    if isinstance(x, NDArray):
+        x = x.data
+    dtype = getattr(x, "dtype", None)
+    if isinstance(x, np.ndarray) or dtype is None:
+        # host arrays — and anything array-LIKE (lists, scalars), which
+        # the historical contract coerced through numpy
+        x = np.asarray(x)
+        if not np.issubdtype(x.dtype, np.floating):
+            return 0
+        return int(x.size - np.isfinite(x).sum())
+    if not jnp.issubdtype(dtype, jnp.floating):
         return 0
-    return int(x.size - np.isfinite(x).sum())
+    return int(jnp.size(x) - jnp.sum(jnp.isfinite(x)))
 
 
 class Monitor:
@@ -61,6 +75,12 @@ class Monitor:
         self.activated = False
         self.queue = []
         self._exe = None
+        # the internals forward is a REAL program: built once per bound
+        # executor, jitted through tracked_jit so its compile lands in the
+        # program registry (label monitor_internals:<fingerprint>) and its
+        # compile seconds in badput/compile — not silently inside whatever
+        # step timing window the first toc() happens to fall in
+        self._graph_fn = None
         # baseline NOW, not lazily: the first collected window must report
         # compiles since the monitor was created, not since process start
         self._compile_snap = None
@@ -81,6 +101,23 @@ class Monitor:
     def install(self, exe):
         """Attach to an Executor (reference: Monitor.install)."""
         self._exe = exe
+        self._graph_fn = None  # new binding: rebuild the internals program
+
+    def _internals_fn(self, internals):
+        """The jitted internals forward, built once per bound executor.
+        Routed through tracked_jit so the compile is an attributed
+        registry entry (label ``monitor_internals:<fingerprint>``), and
+        its seconds fold into badput/compile via record_compile_badput
+        (idempotent watermark) instead of silently polluting whatever
+        step timing window the first collection lands in."""
+        from .utils import compile as compile_mod
+
+        if self._graph_fn is None:
+            fn = _build_graph_fn(internals, is_train=False)
+            label = ("monitor_internals:"
+                     + compile_mod.graph_fingerprint(internals))
+            self._graph_fn = compile_mod.tracked_jit(fn, label=label)
+        return self._graph_fn
 
     def tic(self):
         if self.step % self.interval == 0:
@@ -93,14 +130,26 @@ class Monitor:
             return []
         self.activated = False
         exe = self._exe
+        from .utils import compile as compile_mod
+
         internals = exe._symbol.get_internals()
-        fn = _build_graph_fn(internals, is_train=False)
+        fn = self._internals_fn(internals)
         args = {n: a._data for n, a in exe.arg_dict.items()}
         aux = {n: a._data for n, a in exe.aux_dict.items()}
+        pre = compile_mod.registry().snapshot()["compile_seconds"]
         outs, _ = fn(args, aux, jnp.zeros((2,), jnp.uint32))
+        post = compile_mod.registry().snapshot()["compile_seconds"]
+        if post > pre:
+            from . import telemetry
+
+            telemetry.record_compile_badput(post, post - pre)
         res = []
         for name, value in zip(internals.list_outputs(), outs):
             if self.pattern.match(name):
+                # ONE host pull shared by the stat and the count —
+                # stat_func needs the numpy copy anyway, and
+                # nonfinite_count on it is a cheap host reduction
+                # (device-side counting is for callers with no host copy)
                 value = np.asarray(value)
                 res.append((self.step, name, self.stat_func(value)))
                 if self.track_nonfinite:

@@ -119,6 +119,21 @@ class SoftmaxOutputOp(OpProp):
         return [_softmax_output_masked(ins[0], ins[1], mask,
                                        self.grad_scale, self.multi_output)], []
 
+    def loss_value(self, out, label, mask=None):
+        """Cross-entropy of the already-computed softmax output — the loss
+        whose gradient this head injects (sum over valid rows, scaled like
+        the injected gradient)."""
+        # p: (batch, C) or multi-output (batch, C, ...), label (batch, ...)
+        # — idx[:, None] expands the class axis for both shapes
+        p = out.astype(jnp.float32)
+        idx = label.astype(jnp.int32)
+        nll = -jnp.log(jnp.take_along_axis(p, idx[:, None], axis=1)[:, 0]
+                       + 1e-12)
+        nll = nll.reshape(nll.shape[0], -1).sum(axis=1)
+        if mask is not None:
+            nll = nll * mask
+        return jnp.sum(nll) * self.grad_scale
+
 
 def _regression_vjp(transform, grad_fn):
     @jax.custom_vjp
@@ -180,6 +195,15 @@ class _RegressionBase(OpProp):
     supports_loss_mask = True
     _kernel = None
     _kernel_masked = None
+    _loss_elem = None  # elementwise loss whose grad is the injected one
+
+    def loss_value(self, out, label, mask=None):
+        o = out.astype(jnp.float32)
+        l = label.astype(jnp.float32).reshape(out.shape)
+        e = type(self)._loss_elem(o, l)
+        if mask is not None:
+            e = e * _row_mask(mask, e.ndim)
+        return jnp.sum(e) * self.grad_scale
 
     def list_arguments(self):
         return ["data", "label"]
@@ -228,6 +252,7 @@ class LinearRegressionOutputOp(_RegressionBase):
 
     _kernel = staticmethod(_linear_regression)
     _kernel_masked = staticmethod(_linear_regression_masked)
+    _loss_elem = staticmethod(lambda o, l: 0.5 * jnp.square(o - l))
 
 
 @register_op("LogisticRegressionOutput")
@@ -237,6 +262,10 @@ class LogisticRegressionOutputOp(_RegressionBase):
 
     _kernel = staticmethod(_logistic_regression)
     _kernel_masked = staticmethod(_logistic_regression_masked)
+    # out is already sigmoid(data); grad (o - l) is BCE's
+    _loss_elem = staticmethod(
+        lambda o, l: -(l * jnp.log(o + 1e-12)
+                       + (1.0 - l) * jnp.log(1.0 - o + 1e-12)))
 
 
 @register_op("MAERegressionOutput")
@@ -246,3 +275,4 @@ class MAERegressionOutputOp(_RegressionBase):
 
     _kernel = staticmethod(_mae_regression)
     _kernel_masked = staticmethod(_mae_regression_masked)
+    _loss_elem = staticmethod(lambda o, l: jnp.abs(o - l))

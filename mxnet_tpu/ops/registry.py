@@ -143,6 +143,17 @@ class OpProp:
             f"{type(self).__name__} does not support loss masking; "
             "PadPolicy needs a mask-capable loss head (see ops/loss.py)")
 
+    def loss_value(self, out, label, mask=None):
+        """The scalar training loss this head's injected gradient descends
+        (trace-safe; telemetry.health's loss stream). Loss heads OUTPUT
+        predictions and inject their gradient through a custom VJP — the
+        seed-ones cotangent scalar the fused step reduces is a gradient
+        seed, CONSTANT for softmax heads — so observability needs this
+        explicit hook. None (the default) = this op cannot price its loss;
+        the health stream falls back to the seed scalar."""
+        del out, label, mask
+        return None
+
     def serialize_params(self) -> dict:
         """JSON-able param dict for Symbol save/load."""
         return {k: (list(v) if isinstance(v, tuple) else v) for k, v in self.attr.items()}

@@ -35,6 +35,11 @@ MX311 flags fleet actuation outside this module).
             (:func:`choose_world`) under the chip budget, actuated via
             ``request_world`` (which prefers the blamed rank as its
             shrink victim — elastic.record_blame).
+  health    RECOMMEND-ONLY (ISSUE 14): a persistent per-layer anomaly
+            from the bound telemetry.HealthMonitor surfaces as a
+            ``controller`` decision event, and evict/retier decisions
+            carry blamed-layer context — the autopilot never actuates
+            on model health (the guard layer owns NaN steps).
 
 **Safety rails** (robustness is the point):
 
@@ -232,6 +237,7 @@ class FleetController:
         self.detector = StreamingStragglerDetector(window=config.window)
         self._lock = named_lock("resilience.FleetController")
         self._co = None
+        self._health = None           # telemetry.HealthMonitor (ISSUE 14)
         self._model_key = None
         self._comm_mode = "none"
         self._can_retier = False
@@ -284,14 +290,18 @@ class FleetController:
 
     def bind(self, coordinator=None, model_key=None, world_size=None,
              comm_mode="none", can_retier=False, fp32_wire_bytes=0.0,
-             logger=None):
+             health=None, logger=None):
         """Attach the controller to one run's levers and identity. The
         membership levers need a ``coordinator``; without one they stay
         disabled (logged). ``fp32_wire_bytes`` is the closed-form per-step
         uncompressed wire cost — the tier policy's fallback when the span
-        window carries no measured wire phase."""
+        window carries no measured wire phase. ``health`` (a telemetry.
+        HealthMonitor, ISSUE 14) adds model-health context: blamed-layer
+        fields on evict/retier decisions and a recommend-only ``health``
+        lever — the controller never actuates on model health."""
         with self._lock:
             self._co = coordinator
+            self._health = health
             self._model_key = model_key
             self._bound_world = int(world_size or
                                     (coordinator.world_size
@@ -326,6 +336,7 @@ class FleetController:
     def unbind(self):
         with self._lock:
             self._co = None
+            self._health = None
             self._pending_retier = None
         self.detector.detach()
 
@@ -545,6 +556,8 @@ class FleetController:
                 self._lever_retier(now)
             if self.cfg.auto_world and self._co is not None:
                 self._lever_world(now)
+            if self._health is not None:
+                self._lever_health()
             return report
 
     _last_report = None
@@ -642,7 +655,8 @@ class FleetController:
 
         if self._act("evict", f"evict rank {blamed}", do, now,
                      rank=blamed, blame=top["blame"], votes=votes,
-                     excess_seconds=top["excess_seconds"]):
+                     excess_seconds=top["excess_seconds"],
+                     **self._health_ctx()):
             self._evictions[blamed] = self._evictions.get(blamed, 0) + 1
             self._departed[blamed] = {"t": now, "reason": "evicted"}
             self._blame_hist.clear()
@@ -710,7 +724,7 @@ class FleetController:
 
         self._act("retier", action, stage, now, mode=mode,
                   bucket_bytes=cap, ratio=None if ratio is None
-                  else round(ratio, 4))
+                  else round(ratio, 4), **self._health_ctx())
 
     def _lever_world(self, now):
         co = self._co
@@ -727,6 +741,32 @@ class FleetController:
                   target=target,
                   perf={str(k): round(v, 6)
                         for k, v in self._world_perf.items()})
+
+    def _health_ctx(self):
+        """Model-health decision context: the currently-blamed layer (if
+        the health monitor flagged one recently). Attached to evict and
+        retier decisions so a post-mortem can correlate a fleet move with
+        the model state it happened under."""
+        if self._health is None:
+            return {}
+        blamed = self._health.blamed_layer()
+        if blamed is None:
+            return {}
+        return {"health_layer": blamed[0], "health_reason": blamed[1]}
+
+    def _lever_health(self):
+        """Recommend-only model-health lever (ISSUE 14): a persistent
+        layer anomaly surfaces as a ``controller`` decision event with
+        outcome ``recommended`` — the autopilot NEVER actuates on model
+        health (hyperparameters are the user's contract; the guard layer
+        already owns NaN steps). Deduped by _emit, so a sustained anomaly
+        costs one incident, not one per tick."""
+        blamed = self._health.blamed_layer()
+        if blamed is None:
+            return
+        layer, reason = blamed
+        self._emit("health", f"inspect layer {layer}: {reason}",
+                   "recommended", layer=layer, reason=reason)
 
     # -- staged actuations (applied by the fit loop) ---------------------------
     def take_retier(self):

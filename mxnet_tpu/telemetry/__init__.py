@@ -52,6 +52,8 @@ from .memory import (ArrayLedger, MemoryPreflightError, track_arrays,
                      plan_table, forensics_snapshot)
 from . import sensors
 from .sensors import StreamingStragglerDetector, comm_compute_ratio
+from . import health
+from .health import HealthConfig, HealthMonitor
 
 # the black box records from import on (and survives hub resets)
 flight.install()
@@ -76,6 +78,7 @@ __all__ = [
     "memory", "ArrayLedger", "MemoryPreflightError", "track_arrays",
     "plan_table", "forensics_snapshot",
     "sensors", "StreamingStragglerDetector", "comm_compute_ratio",
+    "health", "HealthConfig", "HealthMonitor",
     "counter", "gauge", "observe", "emit", "TelemetryConfig",
     "maybe_serve_http_from_env",
 ]

@@ -5,6 +5,7 @@
     python -m mxnet_tpu.telemetry merge r0.jsonl r1.jsonl ... -o fleet.json
     python -m mxnet_tpu.telemetry diff A.jsonl B.jsonl [--threshold 10]
     python -m mxnet_tpu.telemetry mem run.jsonl
+    python -m mxnet_tpu.telemetry health run.jsonl [-n 20]
     python -m mxnet_tpu.telemetry flight show dump.json [-n 10]
     python -m mxnet_tpu.telemetry flight validate dump.json
 
@@ -17,7 +18,10 @@ step-time/MFU/goodput percentiles AND the peak live-array watermark
 between two runs and exits nonzero on a regression beyond the threshold
 — a CI perf gate. ``mem`` renders the memory-observability view of a run:
 the per-program HBM plan table (``--jaxpr-table`` style), per-epoch
-watermarks, and any leak/preflight incidents. ``flight`` renders and
+watermarks, and any leak/preflight incidents. ``health`` renders the
+training-health view: the per-layer statistics table (last/max gradient
+norm, update:weight ratio, nonfinite totals from the in-graph stats
+engine) and the anomaly timeline the streaming detectors raised. ``flight`` renders and
 CRC-validates flight-recorder dumps (including the memory snapshot
 section). All readers take schema v1 (PR 5) and v2 (distributed tracing)
 files; v1 rows read as rank 0 of world 1.
@@ -172,6 +176,49 @@ def cmd_mem(args):
               f"{float(e.get('total_bytes', 0)) / (1 << 20):.2f} MB needed, "
               + (f"budget {float(budget) / (1 << 20):.2f} MB — {verdict}"
                  if budget else "no budget configured"))
+    return 0
+
+
+def cmd_health(args):
+    """The model-health view of one run's JSONL stream: per-layer stats
+    table + the anomaly timeline (ISSUE 14)."""
+    from .health import aggregate_events
+
+    events = read_events(args.path)
+    health = [e for e in events if e.get("kind") == "health"]
+    anomalies = [e for e in events if e.get("kind") == "health_anomaly"]
+    if not health and not anomalies:
+        print(f"{args.path}: no health events (run fit with health=True "
+              f"or MXNET_TPU_HEALTH=1 and a JSONL telemetry sink)")
+        return 1
+    layers = aggregate_events(events)
+    print(f"{args.path}: {len(health)} health step(s), "
+          f"{len(anomalies)} anomal"
+          f"{'y' if len(anomalies) == 1 else 'ies'}")
+    if layers:
+        print(f"{'layer':<20s} {'grad_norm':>12s} {'max':>12s} "
+              f"{'weight_norm':>12s} {'upd:w':>10s} {'nonfinite':>9s} "
+              f"{'anomalies':>9s}")
+        for layer, agg in sorted(layers.items()):
+            print(f"{layer:<20s} {agg['grad_norm']:>12.4g} "
+                  f"{agg['max_grad_norm']:>12.4g} "
+                  f"{agg['weight_norm']:>12.4g} "
+                  f"{agg['update_ratio']:>10.3g} {agg['nonfinite']:>9d} "
+                  f"{agg['anomalies']:>9d}")
+    if health:
+        last = health[-1]
+        print(f"last step: epoch {last.get('epoch')} step "
+              f"{last.get('step')} loss {float(last.get('loss', 0.0)):.6g}")
+    if anomalies:
+        print(f"anomaly timeline (last {min(args.n, len(anomalies))} of "
+              f"{len(anomalies)}):")
+        for e in anomalies[-args.n:]:
+            where = f" layer={e['layer']}" if e.get("layer") else ""
+            print(f"  [e{e.get('epoch')} s{e.get('step')}] "
+                  f"{e.get('reason')}{where} value={e.get('value')} "
+                  f"threshold={e.get('threshold')}")
+    else:
+        print("no anomalies flagged")
     return 0
 
 
@@ -372,6 +419,11 @@ def main(argv=None):
                                     "incidents")
     mm.add_argument("path")
     mm.set_defaults(fn=cmd_mem)
+    hh = sub.add_parser("health", help="training-health view: per-layer "
+                                       "stats table + anomaly timeline")
+    hh.add_argument("path")
+    hh.add_argument("-n", type=int, default=20)
+    hh.set_defaults(fn=cmd_health)
     f = sub.add_parser("flight", help="render / CRC-validate a flight "
                                       "recorder dump")
     f.add_argument("action", choices=("show", "validate"))

@@ -65,6 +65,10 @@ EVENT_GOLDEN_KEYS = {
     "memory_watermark": ("epoch", "watermark_bytes", "live_bytes"),
     "memory_leak": ("epoch", "drift_bytes", "watermark_bytes"),
     "memory_preflight": ("what", "total_bytes", "fits"),
+    # training-health observability (ISSUE 14): per-step in-graph layer
+    # stats + the anomaly incidents the streaming detectors raise
+    "health": ("epoch", "step", "loss", "stats"),
+    "health_anomaly": ("reason", "epoch", "step", "layer"),
 }
 
 
@@ -130,6 +134,14 @@ def read_events(path):
                 row.setdefault("trace_id", None)
                 row.setdefault("span_id", None)
                 row.setdefault("wall_ts", row.get("ts", 0.0))
+        # health events written by early/hand-rolled producers (ISSUE 14):
+        # fill the additive fields so the CLI and detectors consume old
+        # and new streams uniformly
+        if row.get("kind") == "health":
+            row.setdefault("stats", {})
+            row.setdefault("finite", True)
+        elif row.get("kind") == "health_anomaly":
+            row.setdefault("layer", None)
     return rows
 
 

@@ -121,6 +121,44 @@ def test_bench_mem_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_MEM_*
 
 
+def test_bench_health_smoke_json_contract():
+    """--health-bench --smoke is the CI guard on the training-health
+    bench entry (ISSUE 14): one JSON line with the contract keys, the
+    ISSUE 14 acceptance bound — on-device stats overhead < 2% of the
+    dp-8 step's FLOPs — a per-layer table from the instrumented run, and
+    the injected-anomaly detection latencies (nonfinite in 0 extra
+    steps, explosion/spike within 1)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--health-bench", "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "flops_per_step_baseline", "flops_per_step_health",
+                "step_ms_baseline", "step_ms_health", "wall_overhead_pct",
+                "health_events", "layers", "detect_latency_steps"):
+        assert key in blob, blob
+    assert blob["metric"] == "health_stats_overhead_pct_of_step"
+    # ACCEPTANCE: the in-graph stats cost < 2% of the step's FLOPs
+    assert 0 < blob["value"] < 2.0, blob
+    assert blob["flops_per_step_health"] > blob["flops_per_step_baseline"]
+    # the instrumented run streamed per-layer stats
+    assert blob["health_events"] > 0
+    assert {row["layer"] for row in blob["layers"]} == {"fc1", "fc2"}
+    for row in blob["layers"]:
+        assert row["max_grad_norm"] > 0, row
+    # ACCEPTANCE: detectors catch the injected anomalies promptly
+    lat = blob["detect_latency_steps"]
+    assert lat["nonfinite"] == 0
+    assert lat["grad_explosion"] is not None and lat["grad_explosion"] <= 1
+    assert lat["loss_spike"] is not None and lat["loss_spike"] <= 1
+    assert blob["smoke"] is True  # smoke runs never write BENCH_HEALTH_*
+
+
 def test_bench_overlap_smoke_json_contract():
     """--overlap-bench --smoke is the CI guard on the comm/compute
     overlap bench entry: one JSON line with the contract keys, the

@@ -613,6 +613,88 @@ def test_tree_has_no_mx311_findings():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+# -- MX313 per-leaf-host-stat-loop fixtures (ISSUE 14) -------------------------
+
+def test_fixture_mx313_per_leaf_stat_loop_in_traced_fn():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def step(params, grads):\n"
+        "    stats = {}\n"
+        "    for name, g in grads.items():\n"
+        "        stats[name] = float(jnp.sum(jnp.abs(g)))\n"
+        "    return stats\n"
+    )
+    findings = lint_source(src, "fx.py")
+    assert _ids(findings) == ["MX313"]
+    assert findings[0].line == 7  # reported at the materializing call
+    # .item() / numpy shapes of the same pattern fire too (numpy also
+    # trips the general traced-numpy rule MX201 — both are real)
+    src2 = src.replace("float(jnp.sum(jnp.abs(g)))", "jnp.sum(g).item()")
+    assert "MX313" in _ids(lint_source(src2, "fx.py"))
+
+
+def test_fixture_mx313_clean_patterns():
+    # a pure-jnp per-leaf loop (unrolled at trace) materializes nothing
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def step(params, grads):\n"
+        "    stats = {}\n"
+        "    for name, g in grads.items():\n"
+        "        stats[name] = jnp.sum(jnp.abs(g))\n"
+        "    return stats\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+    # the same loop OUTSIDE traced code is host-side tooling (the
+    # sanctioned Monitor shape), not a traced-loop hazard
+    src2 = (
+        "def summarize(grads):\n"
+        "    out = {}\n"
+        "    for name, g in grads.items():\n"
+        "        out[name] = float(abs(g).sum())\n"
+        "    return out\n"
+    )
+    assert _ids(lint_source(src2, "fx.py")) == []
+    # loops not over gradient-named values stay clean
+    src3 = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(params, batches):\n"
+        "    for b in batches:\n"
+        "        x = float(b)\n"
+        "    return x\n"
+    )
+    assert _ids(lint_source(src3, "fx.py")) == []
+
+
+def test_fixture_mx313_pragma():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def step(grads):\n"
+        "    out = []\n"
+        "    for g in grads:\n"
+        "        out.append(float(jnp.sum(g)))  "
+        "# mxlint: disable=MX313 - debug tool\n"
+        "    return out\n"
+    )
+    assert _ids(lint_source(src, "fx.py")) == []
+
+
+def test_tree_has_no_mx313_findings():
+    """ISSUE 14 satellite: the tree self-lints clean — per-layer stats
+    come from the in-graph health engine, not per-leaf host pulls."""
+    from mxnet_tpu.analysis import lint_paths
+
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX313"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 # -- MX307 leaked-span fixtures (ISSUE 6 satellite) ----------------------------
 
 def test_fixture_mx307_leaked_span():

@@ -155,6 +155,12 @@ def test_jsonl_schema_golden_keys(tmp_path):
            outcome="actuated", rank=7, votes=3, dry_run=False)
     h.emit("breaker", breaker="controller", state="open",
            from_state="closed", failures=2)
+    # training-health kinds (ISSUE 14)
+    h.emit("health", epoch=0, step=3, loss=1.25, finite=True,
+           stats={"fc1": {"grad_norm": 0.5, "weight_norm": 1.0,
+                          "update_ratio": 1e-3, "nonfinite": 0}})
+    h.emit("health_anomaly", reason="grad_explosion", layer="fc1",
+           epoch=0, step=3, value=1e7, threshold=1e6)
     path = str(tmp_path / "events.jsonl")
     telemetry.write_jsonl(path, h.events())
     rows = telemetry.read_jsonl(path)
