@@ -262,6 +262,22 @@ register_rule(
     "with a justification")
 
 register_rule(
+    "MX314", "warning",
+    "raw jax.profiler capture outside the profiling layer, or a "
+    "start_trace without a finally-guarded stop: jax's profiler is "
+    "process-global (one trace at a time), so a stray "
+    "`jax.profiler.start_trace`/`jax.profiler.trace` outside "
+    "utils/profiler.py / telemetry/profiling.py races the framework's "
+    "bounded capture windows, is invisible to the JSONL stream (no hub "
+    "event), and is never priced as `profile` badput; a start_trace "
+    "whose stop is not in a `finally` leaks a running trace past the "
+    "first exception — every later capture then fails",
+    "route captures through telemetry.profiling (capture() / "
+    "start_capture + finally-guarded stop_capture) or "
+    "utils.profiler.profile_step; a deliberate raw capture carries "
+    "`# mxlint: disable=MX314` with a justification")
+
+register_rule(
     "MX306", "warning",
     "un-barriered wall-clock delta around device dispatch: a "
     "time.time()/perf_counter() start/stop pair with work between and no "

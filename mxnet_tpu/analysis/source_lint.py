@@ -32,6 +32,12 @@ Catches, before anything imports or traces:
                .item()/numpy per parameter per step) — the pattern the
                in-graph health stats engine (telemetry.health) replaces
                with one fused per-layer reduction + a single pull,
+  MX314        raw jax.profiler captures (start_trace/trace) outside
+               utils/profiler.py / telemetry/profiling.py, and any
+               start_trace without a finally-guarded stop — the profiler
+               is process-global, so strays race the framework's bounded
+               capture windows and a leaked trace breaks every later one
+               (telemetry.profiling.capture() is the sanctioned shape),
   MX601-602    robustness hazards (bare ``except:``; ``while True`` retry
                loops that swallow exceptions with no backoff/deadline —
                the loop shape that melts a parameter server under a
@@ -1067,6 +1073,114 @@ def _scan_fleet_actuation(tree, path, findings):
             path=path, line=node.lineno, col=node.col_offset))
 
 
+# -- MX314: raw jax.profiler captures outside the profiling layer -------------
+# ISSUE 15: every capture flows through telemetry/profiling.py (hub events
+# for the JSONL stream, soft failure on concurrent windows, `profile`
+# badput pricing) or the utils/profiler wrappers over it. Two shapes of
+# drift: (a) a literal `jax.profiler.start_trace/stop_trace/trace` call
+# site outside the two owner modules; (b) ANY `start_trace(...)` call —
+# the sanctioned wrapper included — in a function with no finally-guarded
+# stop, which leaks a running process-global trace past the first
+# exception. Zero-FP-biased: (a) only fires when the receiver is
+# literally `jax.profiler` or a name bound by `from jax import profiler`;
+# tests, examples, and fixtures are exempt.
+
+_MX314_OWNER_FILES = ("profiler.py", "profiling.py")
+
+
+def _mx314_exempt(path: str) -> bool:
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if any(p in ("tests", "examples", "fixtures") for p in parts):
+        return True
+    base = os.path.basename(norm)
+    return base in _MX314_OWNER_FILES or base.startswith("test_")
+
+
+def _is_jax_profiler_receiver(func: ast.Attribute, jp_names) -> bool:
+    recv = func.value
+    if isinstance(recv, ast.Attribute) and recv.attr == "profiler" and \
+            isinstance(recv.value, ast.Name) and recv.value.id == "jax":
+        return True  # jax.profiler.<x>
+    return isinstance(recv, ast.Name) and recv.id in jp_names
+
+
+def _scan_profiler_discipline(tree, path, findings):
+    if _mx314_exempt(path):
+        return
+    jp_names = set()  # names bound by `from jax import profiler [as x]`
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "profiler":
+                    jp_names.add(alias.asname or alias.name)
+    flagged: set = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr not in ("trace", "start_trace", "stop_trace"):
+            continue
+        if not _is_jax_profiler_receiver(node.func, jp_names):
+            continue
+        flagged.add(id(node))
+        findings.append(Finding(
+            get_rule("MX314"),
+            f"raw `jax.profiler.{node.func.attr}` outside utils/profiler.py"
+            " / telemetry/profiling.py — captures flow through "
+            "telemetry.profiling (hub events, `profile` badput pricing, "
+            "safe behavior under concurrent windows)",
+            path=path, line=node.lineno, col=node.col_offset))
+
+    # (b) start_trace/start_capture calls owned by their INNERMOST
+    # function scope; a scope is clean when any finally block in IT stops
+    # the trace. Nested defs always open a fresh scope — including defs
+    # that sit inside a try/finally body, whose deferred bodies run long
+    # after the outer finally fired.
+    scope_starts: dict = {}
+    scope_guarded: dict = {}
+
+    def child_walk(child, scope, in_finally):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            walk(child, id(child), False)
+        else:
+            walk(child, scope, in_finally)
+
+    def walk(node, scope, in_finally):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                getattr(f, "id", None)
+            if name in ("start_trace", "start_capture"):
+                scope_starts.setdefault(scope, []).append((node, name))
+            elif name in ("stop_trace", "stop_capture") and in_finally:
+                scope_guarded[scope] = True
+        if isinstance(node, ast.Try):
+            for child in node.body + node.orelse + node.handlers:
+                child_walk(child, scope, in_finally)
+            for child in node.finalbody:
+                child_walk(child, scope, True)
+            return
+        for child in ast.iter_child_nodes(node):
+            child_walk(child, scope, in_finally)
+
+    walk(tree, id(tree), False)
+    for scope, calls in scope_starts.items():
+        if scope_guarded.get(scope):
+            continue
+        for call, name in calls:
+            if id(call) in flagged:
+                continue  # already reported as a raw capture above
+            findings.append(Finding(
+                get_rule("MX314"),
+                f"`{name}` without a finally-guarded stop in the same "
+                "function — an exception leaks the process-global running "
+                "trace and every later capture fails (use "
+                "telemetry.profiling.capture(), or stop in a `finally`)",
+                path=path, line=call.lineno, col=call.col_offset))
+
+
 # calls whose presence inside a retry loop counts as bounding it: anything
 # sleep/backoff/wait-shaped (time.sleep, policy backoff, cv.wait_for, ...)
 _BOUNDING_CALL_PARTS = ("sleep", "backoff", "wait", "delay", "retry_call",
@@ -1183,6 +1297,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     _scan_world_literal_closures(tree, path, scan.findings)
     _scan_fleet_actuation(tree, path, scan.findings)
     _scan_kernel_discipline(tree, path, scan.findings)
+    _scan_profiler_discipline(tree, path, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)
     roots += [d for d in scan.defs if d.name in scan.traced_names]

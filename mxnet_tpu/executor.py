@@ -191,12 +191,24 @@ def _build_graph_fn(symbol, is_train: bool):
         so block externals can never disagree with what runs here.
         ``mask`` is the optional (batch,) loss validity mask (PadPolicy):
         loss heads route through fwd_masked so padded rows inject no
-        gradient."""
+        gradient.
+
+        Every op emits under ``jax.named_scope(<layer>/<op>)`` so XLA op
+        metadata names its source layer — the provenance the device-time
+        profiler (telemetry/profiling.py) joins measured trace events back
+        through. Scopes are trace-time metadata only: the jaxpr, the
+        compiled program's cache keys, and the zero-recompile invariant
+        are untouched, and backward ops inherit the scope through jax's
+        transpose machinery."""
         if id(node) in skip_bn:  # executes inside its fused add below
             return
         if id(node) in passthrough:  # relu folded into the producer
             env[(id(node), 0)] = env[node_input_refs(node)[0]]
             return
+        with jax.named_scope(f"{node.name}/{node.op.name}"):
+            _exec_node_scoped(i, node, env, aux_values, new_aux, rng, mask)
+
+    def _exec_node_scoped(i, node, env, aux_values, new_aux, rng, mask):
         if id(node) in fused_add:
             # node_input_refs ordering contract: bn.inputs..., then z
             refs = node_input_refs(node)

@@ -656,9 +656,10 @@ class FeedForward(BASE_ESTIMATOR):
                     p_c, b_c = p, batch
                 outs, new_aux = graph_fn({**p_c, **b_c}, aux, rng, mask)
                 # seed-ones cotangent: loss heads inject their own gradient
-                loss = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
-                if scale is not None:
-                    loss = loss * scale
+                with jax.named_scope("loss/sum"):
+                    loss = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+                    if scale is not None:
+                        loss = loss * scale
                 return loss, (outs, new_aux)
 
             (loss, (outs, new_aux)), grads = jax.value_and_grad(
@@ -670,52 +671,60 @@ class FeedForward(BASE_ESTIMATOR):
             if in_shard:
                 # explicit gradient sync (sum semantics, matching the
                 # partitioner-inserted psum; the optimizer's rescale_grad
-                # turns the sum into the mean)
-                if overlap_plan is not None:
-                    grads, resid = comm_mod.overlap_allreduce(
-                        grads, cstate["resid"] if has_cstate else None,
-                        overlap_plan, axis_name="dp", average=False,
-                        kernels=comm_kernels)
-                    if has_cstate:
+                # turns the sum into the mean). Scoped "comm/..." so the
+                # device-time profiler attributes the wire's device cost.
+                with jax.named_scope("comm/allreduce"):
+                    if overlap_plan is not None:
+                        grads, resid = comm_mod.overlap_allreduce(
+                            grads, cstate["resid"] if has_cstate else None,
+                            overlap_plan, axis_name="dp", average=False,
+                            kernels=comm_kernels)
+                        if has_cstate:
+                            new_cstate = {"resid": resid}
+                    elif has_cstate:
+                        grads, resid = comm_mod.error_feedback_allreduce(
+                            grads, cstate["resid"], comm_spec,
+                            axis_name="dp", axis_size=axis_size,
+                            average=False, kernels=comm_kernels)
                         new_cstate = {"resid": resid}
-                elif has_cstate:
-                    grads, resid = comm_mod.error_feedback_allreduce(
-                        grads, cstate["resid"], comm_spec, axis_name="dp",
-                        axis_size=axis_size, average=False,
-                        kernels=comm_kernels)
-                    new_cstate = {"resid": resid}
-                else:
-                    grads = comm_mod.compressed_allreduce(
-                        grads, comm_spec, axis_name="dp",
-                        axis_size=axis_size, average=False,
-                        kernels=comm_kernels)
-                loss = jax.lax.psum(loss, "dp")
-                new_aux = jax.tree_util.tree_map(
-                    lambda a: jax.lax.pmean(a, "dp")
-                    if jnp.issubdtype(a.dtype, jnp.floating) else a, new_aux)
+                    else:
+                        grads = comm_mod.compressed_allreduce(
+                            grads, comm_spec, axis_name="dp",
+                            axis_size=axis_size, average=False,
+                            kernels=comm_kernels)
+                    loss = jax.lax.psum(loss, "dp")
+                    new_aux = jax.tree_util.tree_map(
+                        lambda a: jax.lax.pmean(a, "dp")
+                        if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                        new_aux)
             h_loss = None
             if health_cfg is not None:
                 # true training loss while the head outputs are still in
                 # hand (the metric fold below drops them)
-                h_loss = _health_loss_value(outs, batch, mask)
-                if h_loss is None:
-                    # no loss head priced itself: the seed scalar is the
-                    # only signal left (already psum'd on the shard path)
-                    h_loss = loss if scale is None else loss / scale
-                elif in_shard:
-                    h_loss = jax.lax.psum(h_loss, "dp")
+                with jax.named_scope("health/loss"):
+                    h_loss = _health_loss_value(outs, batch, mask)
+                    if h_loss is None:
+                        # no loss head priced itself: the seed scalar is
+                        # the only signal left (already psum'd on the
+                        # shard path)
+                        h_loss = loss if scale is None else loss / scale
+                    elif in_shard:
+                        h_loss = jax.lax.psum(h_loss, "dp")
             finite = None
             if guard_cfg is not None and guard_cfg.skip_nonfinite:
                 # scaled loss + unscaled grads: overflow in either shows up
-                finite = guards_mod.finite_flag(loss, grads)
+                with jax.named_scope("guards/finite"):
+                    finite = guards_mod.finite_flag(loss, grads)
             if apply_update:
-                new_params, new_opt_state = optimizer.apply(
-                    params, grads, opt_state, lr)
+                with jax.named_scope("optimizer/update"):
+                    new_params, new_opt_state = optimizer.apply(
+                        params, grads, opt_state, lr)
                 if finite is not None:
-                    new_params = guards_mod.guard_select(
-                        finite, new_params, params)
-                    new_opt_state = guards_mod.guard_select(
-                        finite, new_opt_state, opt_state)
+                    with jax.named_scope("guards/select"):
+                        new_params = guards_mod.guard_select(
+                            finite, new_params, params)
+                        new_opt_state = guards_mod.guard_select(
+                            finite, new_opt_state, opt_state)
             else:
                 # update-on-kvstore (dist_async): grads come back in the
                 # params slot; the parameter host applies the optimizer
@@ -724,40 +733,45 @@ class FeedForward(BASE_ESTIMATOR):
                 # aux (e.g. batchnorm moving stats) is updated by the
                 # forward pass on BOTH paths — a NaN step must not poison
                 # it even when the optimizer update happens elsewhere
-                new_aux = guards_mod.guard_select(finite, new_aux, aux)
+                with jax.named_scope("guards/select"):
+                    new_aux = guards_mod.guard_select(finite, new_aux, aux)
             if metric_update is not None:
                 # fold metric accumulation into the same XLA program — no
                 # per-batch host pull (every pull is a device round-trip) —
                 # and drop the forward outputs from the program: nothing
                 # reads them, so XLA needn't materialize them every step
-                labels = [batch[n] for n in label_names]
-                outs_f32 = [o.astype(jnp.float32) for o in outs]
-                base = mstate
-                if in_shard:
-                    # device metrics are additive (sum, count) accumulators:
-                    # fold each shard's DELTA from a zero state, psum it,
-                    # and add — updating from mstate per shard would count
-                    # the replicated base axis_size times
-                    base = jax.tree_util.tree_map(jnp.zeros_like, mstate)
-                if mask is not None:
-                    new_mstate = metric_update(base, labels, outs_f32,
-                                               valid=mask)
-                else:
-                    new_mstate = metric_update(base, labels, outs_f32)
-                if in_shard:
-                    delta = jax.tree_util.tree_map(
-                        lambda d: jax.lax.psum(d, "dp"), new_mstate)
-                    new_mstate = jax.tree_util.tree_map(jnp.add, mstate,
-                                                        delta)
-                if finite is not None:
-                    new_mstate = guards_mod.guard_select(
-                        finite, new_mstate, mstate)
-                mstate = new_mstate
+                with jax.named_scope("metric/update"):
+                    labels = [batch[n] for n in label_names]
+                    outs_f32 = [o.astype(jnp.float32) for o in outs]
+                    base = mstate
+                    if in_shard:
+                        # device metrics are additive (sum, count)
+                        # accumulators: fold each shard's DELTA from a zero
+                        # state, psum it, and add — updating from mstate
+                        # per shard would count the replicated base
+                        # axis_size times
+                        base = jax.tree_util.tree_map(jnp.zeros_like,
+                                                      mstate)
+                    if mask is not None:
+                        new_mstate = metric_update(base, labels, outs_f32,
+                                                   valid=mask)
+                    else:
+                        new_mstate = metric_update(base, labels, outs_f32)
+                    if in_shard:
+                        delta = jax.tree_util.tree_map(
+                            lambda d: jax.lax.psum(d, "dp"), new_mstate)
+                        new_mstate = jax.tree_util.tree_map(jnp.add, mstate,
+                                                            delta)
+                    if finite is not None:
+                        new_mstate = guards_mod.guard_select(
+                            finite, new_mstate, mstate)
+                    mstate = new_mstate
                 outs = ()
             if guard_cfg is not None:
-                gstate = guards_mod.update_guard_state(
-                    guard_cfg, gstate,
-                    finite if finite is not None else jnp.bool_(True))
+                with jax.named_scope("guards/update"):
+                    gstate = guards_mod.update_guard_state(
+                        guard_cfg, gstate,
+                        finite if finite is not None else jnp.bool_(True))
             new_hstate = hstate
             if health_cfg is not None:
                 # per-layer stats from the grads the optimizer consumed
@@ -766,8 +780,9 @@ class FeedForward(BASE_ESTIMATOR):
                 # post-guard-select params: a skipped step reads as
                 # update_ratio 0 while its grad norms still show the
                 # explosion that tripped the guard
-                new_hstate = telemetry_mod.health.device_stats(
-                    health_groups, params, grads, new_params, h_loss)
+                with jax.named_scope("health/stats"):
+                    new_hstate = telemetry_mod.health.device_stats(
+                        health_groups, params, grads, new_params, h_loss)
             return (new_params, new_opt_state, new_aux, outs, mstate, gstate,
                     new_cstate, new_hstate)
 
@@ -995,7 +1010,8 @@ class FeedForward(BASE_ESTIMATOR):
             logger=None, work_load_list=None, batch_size=128,
             sharded_checkpoint_dir=None, guards=None, pad_policy=None,
             compression=None, overlap=None, comm_kernels=None,
-            telemetry=None, elastic=None, controller=None, health=None):
+            telemetry=None, elastic=None, controller=None, health=None,
+            profile=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -1123,10 +1139,26 @@ class FeedForward(BASE_ESTIMATOR):
         explosions, dead layers, slow divergence drift, NaN/Inf — each
         hit a ``health_anomaly`` flight-recorder incident naming the
         layer, emitted BEFORE the guard-skip event it explains
-        (doc/developer-guide/telemetry.md, "Training health")."""
+        (doc/developer-guide/telemetry.md, "Training health").
+
+        ``profile``: measured device-time attribution — None (default;
+        env gate ``MXNET_TPU_PROFILE``, an integer value = window steps),
+        True, an int (window steps), or a telemetry.ProfileConfig. When
+        armed, the loop opens ONE bounded K-step capture window through
+        ``jax.profiler`` after warmup on a compile-quiet step, joins the
+        measured per-instruction device time back to layers/kernels via
+        the named-scope HLO metadata (coverage ratio + explicit
+        unattributed row), produces measured roofline rows
+        (``source: "measured"``) against the jaxpr-audit/kernel-registry
+        FLOP models, and reconciles measured vs modeled MFU. The window's
+        wall time is priced as a ``profile`` badput bucket; the report
+        lands on ``self.profile_report`` and as a ``profile`` summary
+        event + ``profile_*`` gauges (doc/developer-guide/telemetry.md,
+        "Device profiling")."""
         del work_load_list
         guard_cfg = guards_mod.GuardConfig.resolve(guards)
         health_cfg = telemetry_mod.HealthConfig.resolve(health)
+        profile_cfg = telemetry_mod.ProfileConfig.resolve(profile)
         pad_policy = compile_mod.PadPolicy.resolve(pad_policy)
         tcfg = telemetry_mod.TelemetryConfig.resolve(telemetry)
         from . import comm as comm_mod
@@ -1546,6 +1578,26 @@ class FeedForward(BASE_ESTIMATOR):
                 telemetry_mod.memory.attach_sampler()
         self._active_timeline = tl
 
+        # -- device-time profiler (ISSUE 15): one bounded capture window,
+        # attributed to layers/kernels through the named-scope metadata ----
+        prof_session = None
+        profile_badput = 0.0
+        if profile_cfg is not None:
+            # attribution keys: every compute node of the symbol (the
+            # scopes exec_node emits) plus the param-derived layer names
+            # (what the health/hub surfaces call a layer)
+            prof_layers = {n.name for n in self.symbol._topo()
+                           if not n.is_variable}
+            prof_layers |= set(telemetry_mod.health.layer_groups(
+                param_names))
+            prof_session = telemetry_mod.profiling.ProfileSession(
+                profile_cfg, layers=prof_layers,
+                num_devices=int(mesh.shape["dp"]) if mesh is not None
+                else 1,
+                mfu_acct=mfu_acct, logger=logger, owner="fit")
+            logger.info("profile: %r armed (window opens after warmup on "
+                        "a compile-quiet step)", profile_cfg)
+
         def _ckpt_seconds():
             h = telemetry_mod.hub().snapshot()["histograms"].get(
                 "checkpoint_save_seconds")
@@ -1630,6 +1682,19 @@ class FeedForward(BASE_ESTIMATOR):
                 f"(checkpoint flushed: "
                 f"{sharded_checkpoint_dir is not None})",
                 step=epoch, epoch=epoch)
+
+        def _state_tail():
+            """The step signature's LIVE state tail [gstate][cstate]
+            [hstate] — one builder for every trace-time consumer (the
+            MFU jaxpr trace, the profiler's HLO harvest), reading the
+            loop's current values at call time. The dispatch sites keep
+            their unrolled shape (donation-hot path)."""
+            tail = () if guard_cfg is None else (gstate,)
+            if cstate is not None:
+                tail += (cstate,)
+            if hstate is not None:
+                tail += (hstate,)
+            return tail
 
         resize_badput = 0.0  # seconds of the current epoch lost to resizes
 
@@ -1903,16 +1968,23 @@ class FeedForward(BASE_ESTIMATOR):
                         # abstract-trace the exact program about to
                         # dispatch (shapes only, pre-donation) for the
                         # jaxpr FLOP table behind the MFU line
-                        mfu_tail = () if guard_cfg is None else (gstate,)
-                        if cstate is not None:
-                            mfu_tail += (cstate,)
-                        if hstate is not None:
-                            mfu_tail += (hstate,)
                         mfu_acct.maybe_trace(
                             train_step._tracked._jitted,
                             (params, opt_state, aux, batch_arrays, rng,
-                             jnp.float32(lr), maccum.state) + mfu_tail
-                            + pad_tail)
+                             jnp.float32(lr), maccum.state)
+                            + _state_tail() + pad_tail)
+                    if prof_session is not None and prof_session.pending:
+                        # maybe open the capture window (warmup done AND
+                        # last step compile-quiet); the args thunk lets the
+                        # session harvest this exact program's HLO metadata
+                        def _prof_args():
+                            return (params, opt_state, aux, batch_arrays,
+                                    rng, jnp.float32(lr), maccum.state) \
+                                + _state_tail() + pad_tail
+                        prof_session.before_step(
+                            getattr(train_step, "_tracked", None),
+                            _prof_args,
+                            compile_mod.registry().snapshot()["compiles"])
                     # state tail mirrors the step signature:
                     # [gstate][cstate][hstate][valid]
                     hs_tail = () if hstate is None else (hstate,)
@@ -2000,6 +2072,12 @@ class FeedForward(BASE_ESTIMATOR):
                                         "step_event", span_kind="step",
                                         epoch=epoch, step=nbatch,
                                         name="guard_skip")
+                    if prof_session is not None and prof_session.open:
+                        # window accounting: the K-th step blocks on its
+                        # outputs, stops the trace, attributes, publishes;
+                        # the wall time returns as `profile` badput
+                        profile_badput += prof_session.after_step(
+                            res, epoch=epoch)
                     step_finite = True
                     if guard_cfg is not None and (async_kv
                                                   or not use_device_metric):
@@ -2098,6 +2176,11 @@ class FeedForward(BASE_ESTIMATOR):
             # ready — a returned dispatch is not a finished step (the
             # un-barriered-timing footgun, mxlint MX306)
             jax.block_until_ready(jax.tree_util.tree_leaves(params)[:1])
+            if prof_session is not None and prof_session.open:
+                # epoch ended inside the window: the device work above has
+                # retired, so close with what was captured rather than
+                # leaking an open trace into the next epoch
+                profile_badput += prof_session.close(epoch=epoch)
             name, value = eval_metric.get()
             logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
             logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
@@ -2211,6 +2294,7 @@ class FeedForward(BASE_ESTIMATOR):
                     if guard_cfg is not None else 0,
                     checkpoint_seconds=_ckpt_seconds() - ckpt_base,
                     resize_seconds=resize_badput,
+                    profile_seconds=profile_badput,
                     logger=logger)
 
             _write_back()
@@ -2237,6 +2321,7 @@ class FeedForward(BASE_ESTIMATOR):
                     cb(epoch, self.symbol, self.arg_params, self.aux_params)
             epoch_tic = None
             resize_badput = 0.0
+            profile_badput = 0.0
             epoch += 1
         finally:
             if watchdog is not None:
@@ -2247,6 +2332,12 @@ class FeedForward(BASE_ESTIMATOR):
                 fleet_ctl.unbind()
             if hmon is not None:
                 hmon.detach()
+            if prof_session is not None:
+                # an exception mid-window must not leave the process-global
+                # jax profiler running; a closed session's close() is a
+                # no-op
+                prof_session.close()
+                self.profile_report = prof_session.report
             if elastic_co is not None:
                 telemetry_mod.set_world(*elastic_prev_world)
             # a mid-step exception (preemption, retry exhaustion) can leave
@@ -2625,18 +2716,30 @@ class FeedForward(BASE_ESTIMATOR):
             maccum.finish()
 
     # -- inference ------------------------------------------------------------
-    def predict(self, X, batch_size=128, telemetry=None):
+    def predict(self, X, batch_size=128, telemetry=None, profile=None):
         """Run forward over X, concatenating outputs (reference: model.py:640).
 
         Returns a single numpy array for single-output nets, else a list.
         ``telemetry`` (None/True/TelemetryConfig, env gate
         ``MXNET_TPU_TELEMETRY``): record a ``predict_step`` span per batch
-        on a fresh StepTimeline at ``self.telemetry``."""
+        on a fresh StepTimeline at ``self.telemetry``. ``profile``
+        (None/True/int/ProfileConfig, env gate ``MXNET_TPU_PROFILE``):
+        capture one bounded window of predict batches and attribute the
+        measured device time to layers (same machinery as
+        ``fit(profile=...)``; report on ``self.profile_report``)."""
         tcfg = telemetry_mod.TelemetryConfig.resolve(telemetry)
+        profile_cfg = telemetry_mod.ProfileConfig.resolve(profile)
         tl = None
         if tcfg is not None and tcfg.timeline:
             tl = telemetry_mod.StepTimeline()
             self.telemetry = tl
+        prof_session = None
+        if profile_cfg is not None:
+            prof_session = telemetry_mod.profiling.ProfileSession(
+                profile_cfg,
+                layers={n.name for n in self.symbol._topo()
+                        if not n.is_variable},
+                num_devices=1, owner="predict")
         data_iter = _init_iter(X, None, batch_size, is_train=False)
         data_names = [x[0] for x in data_iter.provide_data]
         if self.arg_params is None:
@@ -2663,7 +2766,13 @@ class FeedForward(BASE_ESTIMATOR):
                 params, batch_arrays, symbol=self._symbol_for_bucket(bkey)))
             if span is not None:
                 span.mark("dispatch")
+            if prof_session is not None and prof_session.pending:
+                prof_session.before_step(
+                    pred, lambda: (params, aux, batch_arrays),
+                    compile_mod.registry().snapshot()["compiles"])
             outs = pred(params, aux, batch_arrays)
+            if prof_session is not None and prof_session.open:
+                prof_session.after_step(outs)
             if span is not None:
                 span.mark("device")
                 jax.block_until_ready(outs)
@@ -2682,6 +2791,9 @@ class FeedForward(BASE_ESTIMATOR):
         finally:
             if tl is not None:  # exception mid-batch: drop the open span
                 telemetry_mod.clear_current_span()
+            if prof_session is not None:
+                prof_session.close()  # short datasets close a partial window
+                self.profile_report = prof_session.report
         results = [np.concatenate(lst, axis=0) for lst in chunks]
         return results[0] if len(results) == 1 else results
 

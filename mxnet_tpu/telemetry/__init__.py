@@ -54,6 +54,8 @@ from . import sensors
 from .sensors import StreamingStragglerDetector, comm_compute_ratio
 from . import health
 from .health import HealthConfig, HealthMonitor
+from . import profiling
+from .profiling import ProfileConfig, ProfileSession
 
 # the black box records from import on (and survives hub resets)
 flight.install()
@@ -79,6 +81,7 @@ __all__ = [
     "plan_table", "forensics_snapshot",
     "sensors", "StreamingStragglerDetector", "comm_compute_ratio",
     "health", "HealthConfig", "HealthMonitor",
+    "profiling", "ProfileConfig", "ProfileSession",
     "counter", "gauge", "observe", "emit", "TelemetryConfig",
     "maybe_serve_http_from_env",
 ]

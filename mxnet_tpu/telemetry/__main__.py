@@ -6,6 +6,7 @@
     python -m mxnet_tpu.telemetry diff A.jsonl B.jsonl [--threshold 10]
     python -m mxnet_tpu.telemetry mem run.jsonl
     python -m mxnet_tpu.telemetry health run.jsonl [-n 20]
+    python -m mxnet_tpu.telemetry profile run.jsonl [-n 20]
     python -m mxnet_tpu.telemetry flight show dump.json [-n 10]
     python -m mxnet_tpu.telemetry flight validate dump.json
 
@@ -21,10 +22,16 @@ the per-program HBM plan table (``--jaxpr-table`` style), per-epoch
 watermarks, and any leak/preflight incidents. ``health`` renders the
 training-health view: the per-layer statistics table (last/max gradient
 norm, update:weight ratio, nonfinite totals from the in-graph stats
-engine) and the anomaly timeline the streaming detectors raised. ``flight`` renders and
-CRC-validates flight-recorder dumps (including the memory snapshot
-section). All readers take schema v1 (PR 5) and v2 (distributed tracing)
-files; v1 rows read as rank 0 of world 1.
+engine) and the anomaly timeline the streaming detectors raised.
+``profile`` renders the measured device-time view (ISSUE 15): the last
+capture's hotspot table, per-layer attribution coverage, measured
+roofline rows (``source: "measured"``), and the measured-vs-modeled MFU
+reconciliation; ``diff`` additionally gates the last capture's top per-op
+times, so a hotspot regression fails CI like a step-time regression.
+``flight`` renders and CRC-validates flight-recorder dumps (including the
+memory snapshot and last-profile sections). All readers take schema v1
+(PR 5) and v2 (distributed tracing) files; v1 rows read as rank 0 of
+world 1.
 """
 
 from __future__ import annotations
@@ -222,6 +229,77 @@ def cmd_health(args):
     return 0
 
 
+def _last_profile_summary(events):
+    """The newest attributed capture summary in a stream, or None."""
+    out = None
+    for e in events:
+        if e.get("kind") == "profile" and \
+                e.get("phase", "summary") == "summary":
+            out = e
+    return out
+
+
+def _render_profile_summary(e, n=20):
+    """Shared hotspot rendering (CLI ``profile`` + ``flight show``)."""
+    cov = e.get("coverage_pct")
+    print(f"device profile: {float(e.get('device_ms', 0.0)):.2f} ms over "
+          f"{e.get('steps')} step(s), window "
+          f"{float(e.get('window_seconds', 0.0)):.3f}s, coverage "
+          + (f"{cov:.1f}%" if isinstance(cov, (int, float)) else "n/a")
+          + f" (unattributed {float(e.get('unattributed_ms', 0.0)):.2f} ms)")
+    top = e.get("top") or []
+    if top:
+        print(f"{'ms':>10s} {'%dev':>6s}  {'layer':<22s} op")
+        for row in top[:n]:
+            print(f"{float(row.get('us', 0.0)) / 1e3:>10.3f} "
+                  f"{float(row.get('pct', 0.0)):>6.1f}  "
+                  f"{(row.get('layer') or '<unattributed>'):<22s} "
+                  f"{row.get('op')}")
+    layers = e.get("layers") or {}
+    if layers:
+        print("per-layer device ms: "
+              + "  ".join(f"{k}={float(v):.3f}"
+                          for k, v in list(layers.items())[:n]))
+    roof = e.get("roofline") or []
+    if roof:
+        print(f"measured roofline ({len(roof)} row(s), source=measured):")
+        print(f"{'op':<28s} {'ms/step':>9s} {'GFLOP/s':>9s} "
+              f"{'%peak':>7s} bound")
+        for row in roof[:n]:
+            pk = row.get("pct_of_peak")
+            print(f"{row.get('op', '?'):<28s} "
+                  f"{float(row.get('measured_ms_per_step', 0.0)):>9.4f} "
+                  f"{float(row.get('achieved_gflops_s', 0.0)):>9.3f} "
+                  + (f"{pk:>7.2f}" if isinstance(pk, (int, float))
+                     else f"{'n/a':>7s}")
+                  + f" {row.get('bound', '?')}")
+    mfu = e.get("mfu") or {}
+    if isinstance(mfu.get("measured_mfu_pct"), (int, float)):
+        modeled = mfu.get("modeled_mfu_pct")
+        print(f"MFU: measured {mfu['measured_mfu_pct']:.2f}% (device clock)"
+              + (f" vs modeled {modeled:.2f}% (wall clock), "
+                 f"delta {mfu.get('delta_pct', 0.0):+.2f}%"
+                 if isinstance(modeled, (int, float)) else ""))
+
+
+def cmd_profile(args):
+    """The measured-device-time view of one run's JSONL stream: the last
+    capture's hotspot table, per-layer attribution, measured roofline
+    rows, and the measured-vs-modeled MFU reconciliation (ISSUE 15)."""
+    events = read_events(args.path)
+    captures = [e for e in events if e.get("kind") == "profile"]
+    summary = _last_profile_summary(events)
+    if summary is None:
+        print(f"{args.path}: no profile summary (run fit/predict with "
+              f"profile=True or MXNET_TPU_PROFILE=1 and a JSONL telemetry "
+              f"sink)"
+              + (f"; {len(captures)} capture event(s) without attribution"
+                 if captures else ""))
+        return 1
+    _render_profile_summary(summary, n=args.n)
+    return 0
+
+
 # diff metrics: (label, extractor over events, higher_is_worse)
 def _span_dur_ms(events):
     return sorted(float(e.get("dur_ms", 0.0)) for e in events
@@ -264,6 +342,21 @@ def _run_metrics(events):
              if e.get("kind") == "memory_watermark"]
     if peaks:
         out["peak_mem_mb"] = (max(peaks) / (1 << 20), True)  # higher=worse
+    # per-program measured op-time rows (ISSUE 15): the last capture's top
+    # hotspots become gated metrics, so a hotspot that regresses beyond
+    # the threshold fails the same CI gate as a step-time regression
+    prof = _last_profile_summary(events)
+    if prof is not None:
+        steps = max(int(prof.get("steps", 1) or 1), 1)
+        for row in (prof.get("top") or [])[:8]:
+            op = row.get("op")
+            if not op:
+                continue
+            name = f"op_ms[{row.get('layer') or 'unattributed'}/{op}]"
+            out[name] = (float(row.get("us", 0.0)) / 1e3 / steps, True)
+        cov = prof.get("coverage_pct")
+        if isinstance(cov, (int, float)):
+            out["profile_coverage_pct"] = (float(cov), False)  # lower=worse
     return out
 
 
@@ -380,6 +473,10 @@ def cmd_flight(args):
                       f"use, peak "
                       f"{float(row.get('peak_bytes_in_use', 0)) / (1 << 20):.2f}"
                       f" MB")
+    prof = payload.get("profile")
+    if isinstance(prof, dict):  # absent on dumps from un-profiled runs
+        print("last device-profile capture:")
+        _render_profile_summary(prof, n=args.n)
     return 0
 
 
@@ -424,6 +521,13 @@ def main(argv=None):
     hh.add_argument("path")
     hh.add_argument("-n", type=int, default=20)
     hh.set_defaults(fn=cmd_health)
+    pp = sub.add_parser("profile", help="measured device-time view: "
+                                        "hotspot table, per-layer "
+                                        "attribution, measured roofline, "
+                                        "measured-vs-modeled MFU")
+    pp.add_argument("path")
+    pp.add_argument("-n", type=int, default=20)
+    pp.set_defaults(fn=cmd_profile)
     f = sub.add_parser("flight", help="render / CRC-validate a flight "
                                       "recorder dump")
     f.add_argument("action", choices=("show", "validate"))

@@ -69,6 +69,10 @@ EVENT_GOLDEN_KEYS = {
     # stats + the anomaly incidents the streaming detectors raise
     "health": ("epoch", "step", "loss", "stats"),
     "health_anomaly": ("reason", "epoch", "step", "layer"),
+    # device-time profiler (ISSUE 15): capture lifecycle + the attributed
+    # summary (phase = "start" | "capture" | "summary"; summaries carry
+    # the hotspot table, per-layer ms, measured roofline + MFU blocks)
+    "profile": ("phase", "steps", "device_ms", "coverage_pct"),
 }
 
 
@@ -142,6 +146,14 @@ def read_events(path):
             row.setdefault("finite", True)
         elif row.get("kind") == "health_anomaly":
             row.setdefault("layer", None)
+        elif row.get("kind") == "profile":
+            # rows from early/hand-rolled producers (ISSUE 15): fill the
+            # additive fields so the CLI/diff consume old streams uniformly
+            row.setdefault("phase", "summary")
+            row.setdefault("steps", 0)
+            row.setdefault("device_ms", 0.0)
+            row.setdefault("coverage_pct", None)
+            row.setdefault("top", [])
     return rows
 
 

@@ -146,6 +146,15 @@ class FlightRecorder:
             mem_snapshot = memory_mod.forensics_snapshot()
         except Exception:
             mem_snapshot = None
+        try:
+            # last device-profile capture (ISSUE 15): the measured hotspot
+            # view of the run that died — same graceful-absence contract
+            # as the memory page (old dumps simply lack the section)
+            from . import profiling as profiling_mod
+
+            prof_snapshot = profiling_mod.last_capture_summary()
+        except Exception:
+            prof_snapshot = None
         payload = {
             "format": FLIGHT_FORMAT,
             "v": SCHEMA_VERSION,
@@ -163,6 +172,8 @@ class FlightRecorder:
         }
         if mem_snapshot is not None:
             payload["memory"] = mem_snapshot
+        if prof_snapshot is not None:
+            payload["profile"] = prof_snapshot
         body = json.dumps(payload, sort_keys=True, default=str)
         blob = {"format": FLIGHT_FORMAT,
                 "crc32": zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF,

@@ -143,6 +143,11 @@ class MFUAccountant:
         self._peak = peak_flops
         self.flops_per_step = None
         self.bytes_per_step = None
+        # per-primitive FLOP/byte rows of the traced program — the
+        # measured-roofline join key for the device-time profiler
+        # (telemetry/profiling.py): measured per-op seconds against these
+        # modeled costs give achieved-FLOP/s and %-of-peak per op
+        self.audit_rows = None
 
     @property
     def peak_flops(self):
@@ -177,6 +182,7 @@ class MFUAccountant:
             report = jaxpr_audit.audit_jaxpr(closed)
             self.flops_per_step = float(report.totals["flops"])
             self.bytes_per_step = float(report.totals["bytes"])
+            self.audit_rows = list(report.rows)
         except Exception as e:  # audit drift must never fail a train step
             logging.debug("telemetry: jaxpr FLOP trace failed (%s); "
                           "trying compiled cost_analysis", e)
@@ -199,15 +205,18 @@ class MFUAccountant:
     # -- epoch reporting ------------------------------------------------------
     def epoch_report(self, epoch, steps, wall_seconds, *, compile_seconds=0.0,
                     data_wait_seconds=0.0, skipped_steps=0, step_retries=0,
-                    checkpoint_seconds=0.0, resize_seconds=0.0, logger=None):
+                    checkpoint_seconds=0.0, resize_seconds=0.0,
+                    profile_seconds=0.0, logger=None):
         """Compute + log + export the epoch's MFU and goodput lines.
 
         Badput buckets (non-overlapping slices of ``wall_seconds``):
         compile (XLA), data stalls, checkpoint flushes, elastic resizes
         (quiesce + reshard + replan + rewarm downtime plus the aborted
-        partial attempt the resize threw away), and wasted steps —
-        retried dispatches plus non-finite skipped steps, each costed at
-        the epoch's mean step time. Returns the report dict."""
+        partial attempt the resize threw away), profile capture windows
+        (the device-time profiler's bounded traces — observation is not
+        throughput), and wasted steps — retried dispatches plus
+        non-finite skipped steps, each costed at the epoch's mean step
+        time. Returns the report dict."""
         logger = logger or logging
         h = _hub()
         steps = max(int(steps), 0)
@@ -219,6 +228,7 @@ class MFUAccountant:
             "data_wait": min(float(data_wait_seconds), wall),
             "checkpoint": min(float(checkpoint_seconds), wall),
             "resize": min(float(resize_seconds), wall),
+            "profile": min(float(profile_seconds), wall),
             "wasted_steps": min(wasted_steps * mean_step, wall),
         }
         bad_total = min(sum(badput.values()), wall)
@@ -258,10 +268,10 @@ class MFUAccountant:
                 h.emit("badput", reason=reason, seconds=seconds, epoch=epoch)
         logger.info(
             "Epoch[%d] Goodput: %.1f%% (badput: compile %.2fs, data-wait "
-            "%.2fs, checkpoint %.2fs, resize %.2fs, wasted steps %d ≈ "
-            "%.2fs)", epoch, goodput, badput["compile"],
+            "%.2fs, checkpoint %.2fs, resize %.2fs, profile %.2fs, wasted "
+            "steps %d ≈ %.2fs)", epoch, goodput, badput["compile"],
             badput["data_wait"], badput["checkpoint"], badput["resize"],
-            wasted_steps, badput["wasted_steps"])
+            badput["profile"], wasted_steps, badput["wasted_steps"])
         h.emit("epoch_summary", **{k: v for k, v in report.items()
                                    if k != "badput"}, **{
             f"badput_{k}_seconds": v for k, v in badput.items()})

@@ -3,16 +3,20 @@ engine debug logs + a python Speedometer; here profiling surfaces the
 JAX/XProf trace machinery directly AND digests the captured device trace
 into a per-op time table — the report the reference's users got from
 nvprof, produced framework-side).
+
+Capture routes through ``telemetry.profiling`` (ISSUE 15) — the one
+sanctioned doorway to ``jax.profiler`` (mxlint MX314): every capture is
+a hub event, stop is always finally-safe, and the layer-attribution
+machinery (``fit(profile=...)``, ``telemetry profile``) shares the same
+window bookkeeping. This module stays the low-level per-op toolkit:
+``trace_op_stats`` aggregates raw instruction time; the attribution /
+measured-roofline report lives in telemetry/profiling.py.
 """
 
 from __future__ import annotations
 
 import collections
 import contextlib
-import glob
-import gzip
-import json
-import os
 import re
 import tempfile
 import time
@@ -25,17 +29,31 @@ __all__ = ["start_trace", "stop_trace", "profile_scope", "Timer",
 
 
 def start_trace(log_dir: str):
-    jax.profiler.start_trace(log_dir)
+    """Start a device-trace capture.
+
+    Routes through the ONE capture path (telemetry.profiling — ISSUE 15):
+    the capture becomes a hub event a JSONL sink sees, concurrent windows
+    fail soft, and :func:`stop_trace` is safe to call unconditionally from
+    a ``finally`` (the shape mxlint MX314 asks of every caller)."""
+    from ..telemetry import profiling
+
+    return profiling.start_capture(log_dir, owner="profiler")
 
 
 def stop_trace():
-    jax.profiler.stop_trace()
+    from ..telemetry import profiling
+
+    profiling.stop_capture()
 
 
 @contextlib.contextmanager
 def profile_scope(name: str):
-    """Annotate a host-side region; nests into device traces via TraceAnnotation."""
-    with jax.profiler.TraceAnnotation(name):
+    """Annotate a region for BOTH trace surfaces: ``TraceAnnotation``
+    nests it into the host lanes of a device trace, and ``named_scope``
+    stamps it into the XLA op metadata of anything traced inside — so a
+    user annotation names its ops in the device-time profiler's
+    attribution tables exactly like a framework layer (ISSUE 15)."""
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
         yield
 
 
@@ -97,40 +115,28 @@ class OpStat(collections.namedtuple("OpStat", "name total_us count")):
 def trace_op_stats(log_dir: str, device_substr: str = "", top: int | None = None):
     """Parse a captured trace directory into per-op device-time stats.
 
-    Reads the ``*.trace.json.gz`` XProf exports under ``log_dir``, keeps
-    event lanes named "XLA Ops" on device processes (TPU or CPU), strips
-    instruction-id suffixes so repeats of the same fusion aggregate, and
-    returns OpStat rows sorted by total time. This is the op breakdown the
-    profiler UI shows, available programmatically (used to find, e.g., that
-    a ResNet step's time lives in conv+stats fusions — see bench.py notes).
+    A rollup over the ONE trace parser
+    (``telemetry.profiling.parse_trace_dir`` — per-instruction events
+    from "XLA Ops" lanes on device processes AND the CPU backend's
+    ``hlo_op``-arg lanes): instruction-id suffixes stripped so repeats
+    of the same fusion aggregate, rows sorted by total time. This is the
+    op breakdown the profiler UI shows, available programmatically (used
+    to find, e.g., that a ResNet step's time lives in conv+stats fusions
+    — see bench.py notes). Wrapper instructions (``call``/``while``) are
+    kept here — this table is the raw per-instruction view; the
+    layer-attributed, double-booking-safe view is
+    telemetry.profiling.build_report.
     """
-    files = sorted(glob.glob(os.path.join(log_dir, "**", "*.trace.json.gz"),
-                             recursive=True))
-    if not files:
-        raise FileNotFoundError(f"no trace.json.gz under {log_dir!r}")
+    from ..telemetry import profiling
+
+    rows = profiling.parse_trace_dir(log_dir, device_substr=device_substr,
+                                     drop_wrappers=False)
     by: collections.Counter = collections.Counter()
     counts: collections.Counter = collections.Counter()
-    for path in files:
-        with gzip.open(path, "rt") as f:
-            data = json.load(f)
-        events = data.get("traceEvents", [])
-        proc_names = {e["pid"]: e["args"].get("name", "")
-                      for e in events
-                      if e.get("ph") == "M" and e.get("name") == "process_name"}
-        lanes = {(e["pid"], e["tid"]): e["args"].get("name", "")
-                 for e in events
-                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
-        for e in events:
-            if e.get("ph") != "X":
-                continue
-            pid, tid = e.get("pid"), e.get("tid")
-            if device_substr and device_substr not in proc_names.get(pid, ""):
-                continue
-            if "XLA Ops" not in lanes.get((pid, tid), ""):
-                continue
-            key = re.sub(r"\.\d+", "", e["name"])
-            by[key] += e.get("dur", 0)
-            counts[key] += 1
+    for (_module, instr), row in rows.items():
+        key = re.sub(r"\.\d+", "", instr)
+        by[key] += row["us"]
+        counts[key] += row["count"]
     stats = [OpStat(name, us, counts[name]) for name, us in by.most_common()]
     return stats[:top] if top else stats
 
@@ -212,12 +218,15 @@ def profile_step(fn, *args, iters: int = 3, log_dir: str | None = None,
     import logging
 
     from . import compile as compile_mod
+    from ..telemetry import profiling
 
     before = compile_mod.registry().snapshot()
     out = fn(*args)
     jax.block_until_ready(out)
     log_dir = log_dir or tempfile.mkdtemp(prefix="mxtpu_profile_")
-    with jax.profiler.trace(log_dir):
+    # the shared capture path (ISSUE 15): finally-guarded stop, hub
+    # events for the JSONL stream, soft failure on a concurrent window
+    with profiling.capture(log_dir, owner="profile_step"):
         for _ in range(iters):
             out = fn(*args)
         jax.block_until_ready(out)

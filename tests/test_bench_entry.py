@@ -347,6 +347,71 @@ def test_bench_kernel_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_KERNELS_*
 
 
+def test_bench_profile_smoke_json_contract():
+    """--profile-bench --smoke is the CI guard on the device-time
+    profiler bench (ISSUE 15): one JSON line with the contract keys, the
+    acceptance bounds — >= 80% of in-window device time attributed to
+    named layers/kernels, out-of-window overhead < 0.5% of a step — a
+    top-K hotspot table, measured roofline rows stamped
+    source="measured", a measured-vs-modeled MFU delta, and the capture
+    window priced as profile badput."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--profile-bench",
+         "--smoke"],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "window_steps",
+                "device_ms", "unattributed_ms", "layers_ms", "top",
+                "roofline", "measured_mfu_pct", "mfu_delta_pct",
+                "profile_badput_s", "out_of_window_poll_ns",
+                "out_of_window_overhead_pct", "step_ms"):
+        assert key in blob, blob
+    assert blob["metric"] == "profile_attribution_coverage_pct"
+    # ACCEPTANCE: >= 80% of in-window device time named, remainder
+    # reported explicitly
+    assert blob["value"] >= 80.0, blob
+    assert blob["unattributed_ms"] >= 0.0
+    # model layers really attributed (not just the pseudo-categories)
+    assert {"fc1", "fc2"} <= set(blob["layers_ms"]), blob["layers_ms"]
+    assert blob["top"] and blob["top"][0]["ms"] > 0
+    # measured roofline rows: source=measured, joined FLOP models, a
+    # bound classification per row
+    assert blob["roofline"], blob
+    for row in blob["roofline"]:
+        assert row["source"] == "measured", row
+        assert row["model_flops"] > 0 and row["measured_ms_per_step"] > 0
+        assert row.get("bound") in ("compute", "bandwidth"), row
+    # the measured-vs-modeled reconciliation resolved
+    assert blob["measured_mfu_pct"] is not None
+    assert blob["mfu_delta_pct"] is not None
+    # ACCEPTANCE: out-of-window overhead < 0.5% of a step; the window
+    # itself priced as profile badput
+    assert 0 <= blob["out_of_window_overhead_pct"] < 0.5, blob
+    assert blob["profile_badput_s"] > 0
+    assert blob["smoke"] is True  # smoke runs never write BENCH_PROFILE_*
+
+
+def test_kernel_bench_roofline_rows_carry_source():
+    """ISSUE 15 satellite: every --kernel-bench roofline row is stamped
+    with its provenance (interpret on the CPU rig) so an interpret-mode
+    estimate can never be read as a device measurement. Asserted on the
+    committed artifact so the full-run schema is pinned without re-running
+    the bench."""
+    path = os.path.join(REPO, "BENCH_KERNELS_r16.json")
+    with open(path) as f:
+        blob = json.load(f)
+    assert blob["kernels"], blob
+    for row in blob["kernels"]:
+        assert row.get("source") in ("interpret", "measured"), row
+        # the CPU artifact ran under the Pallas interpreter
+        if blob.get("interpret_mode"):
+            assert row["source"] == "interpret", row
+
+
 @pytest.mark.slow
 def test_bench_pipeline_mode_json_contract(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -867,6 +867,145 @@ def test_self_lint_mx312_clean():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+# -- MX314 raw-profiler-capture fixtures (ISSUE 15) ----------------------------
+
+def test_fixture_mx314_raw_jax_profiler_capture():
+    # a raw jax.profiler capture outside utils/profiler.py /
+    # telemetry/profiling.py: both the start and the raw stop fire
+    src = (
+        "import jax\n"
+        "def cap(d):\n"
+        "    jax.profiler.start_trace(d)\n"
+        "    run()\n"
+        "    jax.profiler.stop_trace()\n"
+    )
+    findings = lint_source(src, "mxnet_tpu/models/fastnet.py")
+    assert [f.rule.id for f in findings] == ["MX314", "MX314"]
+    assert [f.line for f in findings] == [3, 5]
+    # the context-manager shape fires too, and so does a name bound by
+    # `from jax import profiler`
+    src2 = (
+        "import jax\n"
+        "def cap(d):\n"
+        "    with jax.profiler.trace(d):\n"
+        "        run()\n"
+    )
+    assert [f.rule.id for f in
+            lint_source(src2, "mxnet_tpu/models/fastnet.py")] == ["MX314"]
+    src3 = (
+        "from jax import profiler\n"
+        "def cap(d):\n"
+        "    profiler.start_trace(d)\n"
+    )
+    assert "MX314" in [f.rule.id for f in
+                       lint_source(src3, "mxnet_tpu/models/fastnet.py")]
+
+
+def test_fixture_mx314_unguarded_start_trace():
+    # even the sanctioned wrapper fires when its stop is not in a
+    # finally: an exception leaks the process-global running trace
+    src = (
+        "from mxnet_tpu.utils import profiler\n"
+        "def cap(d):\n"
+        "    profiler.start_trace(d)\n"
+        "    run()\n"
+        "    profiler.stop_trace()\n"
+    )
+    findings = lint_source(src, "mxnet_tpu/models/fastnet.py")
+    assert [f.rule.id for f in findings] == ["MX314"]
+    assert findings[0].line == 3
+    assert "finally" in findings[0].message
+    # the low-level capture API leaks identically and fires identically
+    src2 = (
+        "from mxnet_tpu.telemetry import profiling\n"
+        "def cap(d):\n"
+        "    profiling.start_capture(d)\n"
+        "    run()\n"
+        "    profiling.stop_capture()\n"
+    )
+    findings = lint_source(src2, "mxnet_tpu/models/fastnet.py")
+    assert [f.rule.id for f in findings] == ["MX314"]
+    assert "start_capture" in findings[0].message
+    # a nested def inside a try body owns ITS start: the outer finally
+    # cannot guard a deferred body that runs after the finally fired
+    src3 = (
+        "from mxnet_tpu.utils import profiler\n"
+        "def f(d):\n"
+        "    try:\n"
+        "        def helper():\n"
+        "            profiler.start_trace(d)\n"
+        "        register(helper)\n"
+        "    finally:\n"
+        "        profiler.stop_trace()\n"
+    )
+    findings = lint_source(src3, "mxnet_tpu/models/fastnet.py")
+    assert [f.line for f in findings] == [5], findings
+
+
+def test_fixture_mx314_guarded_and_capture_clean():
+    # finally-guarded stop: clean
+    src = (
+        "from mxnet_tpu.utils import profiler\n"
+        "def cap(d):\n"
+        "    profiler.start_trace(d)\n"
+        "    try:\n"
+        "        run()\n"
+        "    finally:\n"
+        "        profiler.stop_trace()\n"
+    )
+    assert lint_source(src, "mxnet_tpu/models/fastnet.py") == []
+    # the sanctioned capture() context manager: clean
+    src2 = (
+        "from mxnet_tpu.telemetry import profiling\n"
+        "def cap(d):\n"
+        "    with profiling.capture(d):\n"
+        "        run()\n"
+    )
+    assert lint_source(src2, "mxnet_tpu/models/fastnet.py") == []
+    # a second function's finally does NOT excuse this one's bare start
+    src3 = (
+        "from mxnet_tpu.utils import profiler\n"
+        "def bare(d):\n"
+        "    profiler.start_trace(d)\n"
+        "def guarded(d):\n"
+        "    profiler.start_trace(d)\n"
+        "    try:\n"
+        "        run()\n"
+        "    finally:\n"
+        "        profiler.stop_trace()\n"
+    )
+    findings = lint_source(src3, "mxnet_tpu/models/fastnet.py")
+    assert [f.line for f in findings] == [3]
+
+
+def test_fixture_mx314_pragma_and_owner_exemptions():
+    src = (
+        "import jax\n"
+        "def cap(d):\n"
+        "    jax.profiler.start_trace(d)"
+        "  # mxlint: disable=MX314 - raw capture for the xprof UI\n"
+    )
+    assert lint_source(src, "mxnet_tpu/models/fastnet.py") == []
+    # the owner modules ARE the sanctioned doorway
+    raw = (
+        "import jax\n"
+        "def start_capture(d):\n"
+        "    jax.profiler.start_trace(d)\n"
+    )
+    assert lint_source(raw, "mxnet_tpu/telemetry/profiling.py") == []
+    assert lint_source(raw, "mxnet_tpu/utils/profiler.py") == []
+
+
+def test_self_lint_mx314_clean():
+    """No raw jax.profiler captures outside the profiling layer, and no
+    unguarded start_trace anywhere in the tree."""
+    from mxnet_tpu.analysis.source_lint import lint_paths
+
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX314"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 # -- MX308 unpinned-wire-collective fixtures (ISSUE 7 satellite) ---------------
 
 def test_fixture_mx308_unpinned_collective():

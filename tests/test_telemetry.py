@@ -161,6 +161,13 @@ def test_jsonl_schema_golden_keys(tmp_path):
                           "update_ratio": 1e-3, "nonfinite": 0}})
     h.emit("health_anomaly", reason="grad_explosion", layer="fc1",
            epoch=0, step=3, value=1e7, threshold=1e6)
+    # device-time profiler kind (ISSUE 15): capture lifecycle + summary
+    h.emit("profile", phase="start", owner="fit", log_dir="/tmp/t",
+           steps=0, device_ms=0.0, coverage_pct=None)
+    h.emit("profile", phase="summary", owner="fit", steps=4,
+           device_ms=12.5, coverage_pct=91.2, window_seconds=0.05,
+           unattributed_ms=1.1,
+           top=[{"layer": "fc1", "op": "dot_general", "us": 9000.0}])
     path = str(tmp_path / "events.jsonl")
     telemetry.write_jsonl(path, h.events())
     rows = telemetry.read_jsonl(path)
