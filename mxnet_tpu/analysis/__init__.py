@@ -22,6 +22,19 @@ Three passes over three representations of the same program:
           named factory, and enabling it records per-thread held-lock
           sets plus the global acquisition-order graph, reporting cycles
           and stalls as hub gauges and flight-recorder incidents.
+  Pass 5  sharding audit (`sharding`)    — audits the LOWERED distributed
+          program: the traced jaxpr (large replicated intermediates
+          MX801, collectives inside scan/while bodies MX803) and the
+          compiled HLO's collective set reconciled EXACTLY against the
+          comm layer's closed-form plan (MX802 — every unplanned
+          all-gather/all-to-all named), plus PartitionSpec sanity
+          (MX804) and a source-level placement-discipline rule (MX805,
+          rides with Pass 1). Wired three ways: the
+          ``--shardcheck``/``--all`` CLI, the opt-in runtime gate
+          ``fit/precompile(shard_audit=True)`` /
+          ``MXNET_TPU_SHARD_AUDIT=1`` auditing the exact warmed
+          program, and ``--ci``/``--baseline`` structured rows with
+          exit 3 on new violations.
 
 Rules live in a registry (`rules`) keyed by stable ids (MX101, ...), each
 with a severity and a fixit hint — adding a rule never touches a driver.
@@ -44,6 +57,9 @@ __all__ = [
     "verify_json", "verify_json_file", "verify_symbol",
     "audit_executor", "audit_jaxpr", "cost_rows", "main",
     "lockwatch", "concurrency_lint_paths", "concurrency_lint_source",
+    "audit_step_program", "audit_collective_drift", "audit_jaxpr_sharding",
+    "check_partition_specs", "expected_collectives", "selfcheck_report",
+    "shard_audit_enabled",
 ]
 
 
@@ -75,6 +91,49 @@ def audit_jaxpr(*args, **kwargs):
 
 def cost_rows(*args, **kwargs):
     from .jaxpr_audit import cost_rows as impl
+
+    return impl(*args, **kwargs)
+
+
+def audit_step_program(*args, **kwargs):
+    """Lazy re-export: Pass 5 pulls in jax; keep the CLI import-light."""
+    from .sharding import audit_step_program as impl
+
+    return impl(*args, **kwargs)
+
+
+def audit_collective_drift(*args, **kwargs):
+    from .sharding import audit_collective_drift as impl
+
+    return impl(*args, **kwargs)
+
+
+def audit_jaxpr_sharding(*args, **kwargs):
+    from .sharding import audit_jaxpr_sharding as impl
+
+    return impl(*args, **kwargs)
+
+
+def check_partition_specs(*args, **kwargs):
+    from .sharding import check_partition_specs as impl
+
+    return impl(*args, **kwargs)
+
+
+def expected_collectives(*args, **kwargs):
+    from .sharding import expected_collectives as impl
+
+    return impl(*args, **kwargs)
+
+
+def selfcheck_report(*args, **kwargs):
+    from .sharding import selfcheck_report as impl
+
+    return impl(*args, **kwargs)
+
+
+def shard_audit_enabled(*args, **kwargs):
+    from .sharding import shard_audit_enabled as impl
 
     return impl(*args, **kwargs)
 

@@ -18,6 +18,11 @@ Rule id bands:
          cycles, bare cv.wait, leaked non-daemon threads, fresh-lock
          locking) — analysis/concurrency.py, with the runtime lock-order
          watchdog (analysis/lockwatch.py) as its dynamic complement
+  MX8xx  SPMD sharding / collective audit (analysis/sharding.py, Pass 5):
+         the lowered distributed program vs the declared comm plan —
+         replicated large intermediates, collective-set drift against
+         allreduce_plan/overlap_plan, collectives inside loop bodies,
+         degenerate PartitionSpecs, raw placement outside the comm owners
 
 Severities: ``error`` fails the CLI (exit 1) and makes ``Symbol.verify``
 raise; ``warning`` is reported but non-fatal; ``info`` is advisory output.
@@ -383,6 +388,65 @@ register_rule(
     "its own instance and the critical section guards nothing",
     "construct the lock once (in __init__, via analysis.lockwatch."
     "named_lock) and reuse that single instance at every site")
+
+# MX8xx — SPMD sharding / collective audit (ISSUE 16: Pass 5 verifies the
+# lowered distributed program against the closed-form comm plan)
+register_rule(
+    "MX801", "warning",
+    "large intermediate fully replicated while the mesh has dp>1: a "
+    "sharding constraint (or lowered program input) pins a tensor above "
+    "the size threshold to full replication, so every device holds and "
+    "computes the whole thing — a silent HBM-times-n and compute-times-n "
+    "multiplier the partitioner will happily lower without complaint",
+    "shard the tensor over the mesh (PartitionSpec naming a mesh axis, "
+    "e.g. P('dp') on the batch dim) or drop the constraint and let "
+    "sharding propagate from the inputs; genuinely-replicated large "
+    "state (frozen embeddings) deserves a comment at the constraint "
+    "site and a raised min_replicated_bytes in the audit call")
+register_rule(
+    "MX802", "error",
+    "collective-set drift: the compiled HLO's collective table does not "
+    "reconcile against the closed-form allreduce_plan/overlap_plan — an "
+    "unplanned all-gather/all-to-all/collective-permute crossed the "
+    "wire, a planned collective is missing (compression silently "
+    "dropped), or a payload's element count/dtype disagrees with the "
+    "plan (the convert-commuting bug class: the wire op lowered at the "
+    "wrong width)",
+    "inspect the reconciliation rows (analysis.sharding."
+    "audit_collective_drift): every HLO collective must be one the plan "
+    "priced; re-pin payloads with lax.optimization_barrier (MX308) if a "
+    "cast commuted across the wire op, and update the plan if the "
+    "program's comm schedule legitimately changed")
+register_rule(
+    "MX803", "warning",
+    "collective inside a scan/while body: the wire cost is paid per "
+    "iteration, multiplying a one-shot collective's bytes by the trip "
+    "count — invisible to the per-step comm plan, which prices the "
+    "program's collectives exactly once",
+    "hoist the collective out of the loop (reduce locally, sync once "
+    "after), or — when per-iteration comm IS the algorithm (ring "
+    "attention's rotating collective-permute) — account it explicitly "
+    "and suppress the finding at the audit call site")
+register_rule(
+    "MX804", "error",
+    "degenerate PartitionSpec: the spec names an axis the mesh does not "
+    "have (XLA treats the dim as replicated — the sharding silently "
+    "never happens), or the batch dimension is unsharded while the mesh "
+    "has dp>1 (every device computes the full batch)",
+    "use mesh axis names exactly as make_mesh declared them (dp/tp/sp) "
+    "and shard the batch dim with P('dp') whenever the dp axis is >1")
+register_rule(
+    "MX805", "warning",
+    "raw sharding placement outside parallel/ + comm/: a "
+    "with_sharding_constraint or device_put(..., NamedSharding(...)) "
+    "call site outside the owner layers scatters placement decisions "
+    "across the codebase — the audit pass and the comm plan can only "
+    "vouch for wire traffic whose placement flows through the owners "
+    "(parallel.shard_batch / replicate_params, the model's _place)",
+    "route the placement through mxnet_tpu.parallel (shard_batch, "
+    "replicate_params) or the model entry points; a deliberate "
+    "placement site (checkpoint restore, a model's declared weight "
+    "shardings) carries `# mxlint: disable=MX805` with a justification")
 
 register_rule(
     "MX602", "error",

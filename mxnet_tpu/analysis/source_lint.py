@@ -1251,6 +1251,97 @@ def _scan_robustness(tree: ast.AST, path: str, findings: list):
                         break
 
 
+# -- MX805: sharding placement outside the parallel/comm owner layers ---------
+# ISSUE 16 (Pass 5 source rule): placement decisions — raw
+# `with_sharding_constraint` and `device_put(x, NamedSharding(...))` —
+# must live in parallel/ or comm/, where the partitioner and the comm
+# plan can account for them. A stray constraint elsewhere silently
+# changes the lowered collective set out from under the MX802
+# reconciliation. Intentional sites (checkpoint restore, model
+# placement helpers) carry `# mxlint: disable=MX805` with a reason.
+
+_MX805_OWNER_DIRS = ("parallel", "comm")
+
+
+def _mx805_exempt(path: str) -> bool:
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if any(p in ("tests", "examples", "fixtures") for p in parts):
+        return True
+    if any(p in _MX805_OWNER_DIRS for p in parts[:-1]):
+        return True
+    return os.path.basename(norm).startswith("test_")
+
+
+def _call_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _contains_namedsharding(node) -> bool:
+    return any(isinstance(sub, ast.Call)
+               and _call_name(sub.func) == "NamedSharding"
+               for sub in ast.walk(node))
+
+
+def _scan_placement_discipline(tree, path, findings):
+    if _mx805_exempt(path):
+        return
+    # names assigned from any expression that builds a NamedSharding —
+    # covers `sh = NamedSharding(...)`, dict/list comprehensions of them,
+    # and `shardings = {k: NamedSharding(...) for ...}` later subscripted
+    sharding_names: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and \
+                node.value is not None and \
+                _contains_namedsharding(node.value):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    sharding_names.add(t.id)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name == "with_sharding_constraint":
+            findings.append(Finding(
+                get_rule("MX805"),
+                "raw `with_sharding_constraint` outside parallel//comm/ "
+                "— placement belongs to the partitioner so the comm plan "
+                "(and the MX802 reconciliation) can account for it",
+                path=path, line=node.lineno, col=node.col_offset))
+            continue
+        if name != "device_put":
+            continue
+        dst = None
+        if len(node.args) >= 2:
+            dst = node.args[1]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "device":
+                    dst = kw.value
+        if dst is None:
+            continue
+        placed = _contains_namedsharding(dst)
+        if isinstance(dst, ast.Name) and dst.id in sharding_names:
+            placed = True
+        if isinstance(dst, ast.Subscript) and \
+                isinstance(dst.value, ast.Name) and \
+                dst.value.id in sharding_names:
+            placed = True
+        if placed:
+            findings.append(Finding(
+                get_rule("MX805"),
+                "`device_put` onto a NamedSharding outside "
+                "parallel//comm/ — sharded placement belongs to the "
+                "owner layers the comm plan audits",
+                path=path, line=node.lineno, col=node.col_offset))
+
+
 def _suppressed(finding: Finding, lines: list[str]) -> bool:
     if not 1 <= finding.line <= len(lines):
         return False
@@ -1298,6 +1389,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     _scan_fleet_actuation(tree, path, scan.findings)
     _scan_kernel_discipline(tree, path, scan.findings)
     _scan_profiler_discipline(tree, path, scan.findings)
+    _scan_placement_discipline(tree, path, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)
     roots += [d for d in scan.defs if d.name in scan.traced_names]

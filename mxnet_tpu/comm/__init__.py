@@ -41,7 +41,8 @@ from .overlap import (OverlapConfig, OverlapPlan, fused_layout_key,
                       overlap_efficiency, plan_overlap,
                       residuals_match_plan, reverse_topo_param_order)
 from .stats import (CommRegistry, allreduce_plan, comm_stats,
-                    fp32_allreduce_wire_bytes, hlo_collective_table,
+                    fp32_allreduce_wire_bytes, hlo_collective_rows,
+                    hlo_collective_table,
                     hlo_collective_wire_bytes, hlo_elementwise_table,
                     hlo_quantize_pass_count, overlap_plan, registry,
                     reset_comm_stats)
@@ -57,6 +58,7 @@ __all__ = [
     "reverse_topo_param_order", "fused_layout_key", "overlap_efficiency",
     "CommRegistry", "registry", "comm_stats", "reset_comm_stats",
     "allreduce_plan", "overlap_plan", "fp32_allreduce_wire_bytes",
-    "hlo_collective_table", "hlo_collective_wire_bytes",
+    "hlo_collective_rows", "hlo_collective_table",
+    "hlo_collective_wire_bytes",
     "hlo_elementwise_table", "hlo_quantize_pass_count",
 ]

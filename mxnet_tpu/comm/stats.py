@@ -36,7 +36,8 @@ from .compression import CompressionSpec, payload_nbytes, quantization_unit
 
 __all__ = ["allreduce_plan", "overlap_plan", "fp32_allreduce_wire_bytes",
            "CommRegistry", "registry", "comm_stats", "reset_comm_stats",
-           "hlo_collective_table", "hlo_collective_wire_bytes",
+           "hlo_collective_table", "hlo_collective_rows",
+           "hlo_collective_wire_bytes",
            "hlo_elementwise_table", "hlo_quantize_pass_count"]
 
 
@@ -256,6 +257,7 @@ _INSTR_RE = re.compile(
 _SHAPE_RE = re.compile(r"(pred|bf16|f16|f32|f64|s8|u8|s16|u16|s32|u32|s64|"
                        r"u64)\[([\d,]*)\]")
 _GROUPS_RE = re.compile(r"replica_groups=\{\{([^}]*)\}")
+_FULL_GROUPS_RE = re.compile(r"replica_groups=(\{\{.*?\}\})")
 _IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
 
 
@@ -269,15 +271,39 @@ def _shape_bytes(shape_str: str) -> int:
     return total
 
 
-def _group_size(line: str, default: int) -> int:
-    m = _GROUPS_RE.search(line)
+def _typed_shapes(shape_str: str) -> list:
+    """Every ``dtype[dims]`` token in a result shape as
+    ``{"dtype", "elements", "bytes"}`` — one entry per tuple member."""
+    parts = []
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        n = 1
+        for d in filter(None, dims.split(",")):
+            n *= int(d)
+        parts.append({"dtype": dtype, "elements": n,
+                      "bytes": n * _DTYPE_BYTES[dtype]})
+    return parts
+
+
+def _replica_groups(line: str, default: int):
+    """``(num_groups, group_size)`` of an instruction's replica groups;
+    ``num_groups`` is ``None`` when the HLO names no groups (then
+    ``group_size`` is the caller's default)."""
+    m = _FULL_GROUPS_RE.search(line)
     if m:
-        ids = [g for g in m.group(1).split(",") if g.strip()]
-        return max(len(ids), 1)
+        text = m.group(1)
+        first = _GROUPS_RE.search(line)
+        ids = [g for g in first.group(1).split(",") if g.strip()] \
+            if first else []
+        size = max(len(ids), 1)
+        return max(text.count("{") - 1, 1), size
     m = _IOTA_GROUPS_RE.search(line)
     if m:  # iota form [num_groups, group_size]<=[...]
-        return max(int(m.group(2)), 1)
-    return default
+        return max(int(m.group(1)), 1), max(int(m.group(2)), 1)
+    return None, default
+
+
+def _group_size(line: str, default: int) -> int:
+    return _replica_groups(line, default)[1]
 
 
 def _wire_bytes(op: str, result_bytes: int, n: int) -> float:
@@ -294,6 +320,51 @@ def _wire_bytes(op: str, result_bytes: int, n: int) -> float:
     return float(result_bytes)      # collective-permute
 
 
+def hlo_collective_rows(hlo_text: str, default_group_size: int = 1) -> list:
+    """Per-INSTANCE collective rows from compiled HLO — the detailed form
+    the MX802 reconciliation (analysis/sharding.py) audits.
+
+    Each row: ``{"op", "async", "payload_bytes", "wire_bytes",
+    "group_size", "replica_groups", "parts"}`` where ``replica_groups``
+    is ``(num_groups, group_size)`` (``num_groups`` None when the HLO
+    names no groups) and ``parts`` is the per-dtype payload breakdown
+    ``[{"dtype", "elements", "bytes"}, ...]`` — one part per tuple member
+    for combined collectives, exactly the logical payload member for
+    async ``-start`` halves (``-done`` halves are skipped).
+    """
+    rows = []
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.search(line)
+        if not m:
+            continue
+        shape_str, op = m.group(1), m.group(2)
+        is_async = bool(m.group(3))
+        if is_async and shape_str.startswith("("):
+            # async -start: result is a tuple aliasing operand and result
+            # buffers; the op's logical result is the LARGEST member
+            # (== result for all-gather, == either for all-reduce) except
+            # for reduce-scatter, whose result is the small shard
+            members = _typed_shapes(shape_str)
+            if members:
+                pick = min if op == "reduce-scatter" else max
+                parts = [pick(members, key=lambda p: p["bytes"])]
+                payload = parts[0]["bytes"]
+            else:
+                parts = []
+                payload = _shape_bytes(shape_str) // 2
+        else:
+            parts = _typed_shapes(shape_str)
+            payload = _shape_bytes(shape_str)
+        num_groups, n = _replica_groups(line, default_group_size)
+        rows.append({
+            "op": op, "async": is_async, "payload_bytes": payload,
+            "wire_bytes": _wire_bytes(op, payload, n),
+            "group_size": n, "replica_groups": (num_groups, n),
+            "parts": parts,
+        })
+    return rows
+
+
 def hlo_collective_table(hlo_text: str, default_group_size: int = 1) -> list:
     """Parse compiled HLO into per-opcode collective byte rows.
 
@@ -301,35 +372,29 @@ def hlo_collective_table(hlo_text: str, default_group_size: int = 1) -> list:
     is the summed result-shape bytes of every instance; wire applies the
     ring factors above with the instruction's replica-group size
     (``default_group_size`` when the HLO names no groups). ``-start``
-    async variants count once; ``-done`` halves are skipped.
+    async variants count once; ``-done`` halves are skipped. Also carries
+    the per-collective detail ISSUE 16 added: ``"elements"`` (summed
+    payload element count), ``"dtypes"`` (sorted payload dtypes), and
+    ``"replica_groups"`` (sorted distinct ``(num_groups, group_size)``
+    shapes) — aggregated from :func:`hlo_collective_rows`.
     """
     by_op: dict[str, dict] = {}
-    for line in hlo_text.splitlines():
-        m = _INSTR_RE.search(line)
-        if not m:
-            continue
-        shape_str, op = m.group(1), m.group(2)
-        if m.group(3) and shape_str.startswith("("):
-            # async -start: result is a tuple aliasing operand and result
-            # buffers; the op's logical result is the LARGEST member
-            # (== result for all-gather, == either for all-reduce) except
-            # for reduce-scatter, whose result is the small shard
-            shapes = [_shape_bytes(s) for s in
-                      re.findall(r"(?:pred|bf16|f16|f32|f64|s8|u8|s16|u16|"
-                                 r"s32|u32|s64|u64)\[[\d,]*\]\S*", shape_str)]
-            if shapes:
-                payload = min(shapes) if op == "reduce-scatter" \
-                    else max(shapes)
-            else:
-                payload = _shape_bytes(shape_str) // 2
-        else:
-            payload = _shape_bytes(shape_str)
-        n = _group_size(line, default_group_size)
-        row = by_op.setdefault(op, {"op": op, "count": 0,
-                                    "payload_bytes": 0, "wire_bytes": 0.0})
+    for r in hlo_collective_rows(hlo_text, default_group_size):
+        row = by_op.setdefault(r["op"], {
+            "op": r["op"], "count": 0, "payload_bytes": 0,
+            "wire_bytes": 0.0, "elements": 0, "dtypes": set(),
+            "replica_groups": set()})
         row["count"] += 1
-        row["payload_bytes"] += payload
-        row["wire_bytes"] += _wire_bytes(op, payload, n)
+        row["payload_bytes"] += r["payload_bytes"]
+        row["wire_bytes"] += r["wire_bytes"]
+        row["elements"] += sum(p["elements"] for p in r["parts"])
+        row["dtypes"].update(p["dtype"] for p in r["parts"])
+        row["replica_groups"].add(r["replica_groups"])
+    for row in by_op.values():
+        row["dtypes"] = sorted(row["dtypes"])
+        row["replica_groups"] = sorted(
+            row["replica_groups"],
+            key=lambda g: (g[0] is None, g))
     return sorted(by_op.values(), key=lambda r: -r["wire_bytes"])
 
 

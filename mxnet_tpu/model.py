@@ -1011,7 +1011,7 @@ class FeedForward(BASE_ESTIMATOR):
             sharded_checkpoint_dir=None, guards=None, pad_policy=None,
             compression=None, overlap=None, comm_kernels=None,
             telemetry=None, elastic=None, controller=None, health=None,
-            profile=None):
+            profile=None, shard_audit=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -1284,6 +1284,16 @@ class FeedForward(BASE_ESTIMATOR):
                 "rides under backward)", overlap_plan.num_buckets,
                 overlap_cfg.bucket_bytes)
 
+        # opt-in shard audit (ISSUE 16): before the first dispatch of each
+        # program, mxlint Pass 5 reconciles the warmed executable's
+        # collective set against the declared comm plan and raises on
+        # MX802 drift — no step runs on a program whose wire traffic the
+        # plan cannot vouch for
+        from .analysis.sharding import shard_audit_enabled
+        shard_audit_on = shard_audit_enabled(shard_audit) \
+            and mesh is not None
+        _shard_audited: set = set()
+
         if async_kv:
             if sharded_checkpoint_dir is not None and num_workers > 1:
                 # single-worker dist_async (one replica, one writer) is
@@ -1414,7 +1424,7 @@ class FeedForward(BASE_ESTIMATOR):
                         logger.info(
                             "EF residual dropped on resume: layout changed "
                             "(%s -> %s)", saved_layout, layout_key)
-            return {"resid": jax.device_put(
+            return {"resid": jax.device_put(  # mxlint: disable=MX805 - resume-path restore of the comm layer's own EF residual, back onto the plan's dp sharding
                 resid, NamedSharding(mesh, P("dp")))}, layout_key
 
         cstate, resid_layout_key = _build_comm_state(resume_comm_state,
@@ -1985,6 +1995,22 @@ class FeedForward(BASE_ESTIMATOR):
                             getattr(train_step, "_tracked", None),
                             _prof_args,
                             compile_mod.registry().snapshot()["compiles"])
+                    if shard_audit_on and bkey not in _shard_audited:
+                        _shard_audited.add(bkey)
+                        tj = getattr(train_step, "_tracked", None)
+                        if tj is not None:
+                            # warms the exact program about to dispatch
+                            # (TrackedJit AOT) and audits its optimized
+                            # HLO; raises on MX802 before the step runs
+                            self._shard_audit_program(
+                                tj,
+                                (params, opt_state, aux, batch_arrays,
+                                 rng, jnp.float32(lr), maccum.state)
+                                + _state_tail() + pad_tail,
+                                mesh=mesh, comm_spec=comm_spec,
+                                overlap_plan=overlap_plan,
+                                flat_elems=comm_mod.flat_size(params),
+                                logger=logger)
                     # state tail mirrors the step signature:
                     # [gstate][cstate][hstate][valid]
                     hs_tail = () if hstate is None else (hstate,)
@@ -2359,7 +2385,7 @@ class FeedForward(BASE_ESTIMATOR):
                    eval_metric="accuracy", kvstore="local", guards=None,
                    pad_policy=None, compression=None, overlap=None,
                    comm_kernels=None, batch_end_callback=None,
-                   health=None, parallel=True):
+                   health=None, parallel=True, shard_audit=None):
         """AOT warmup: compile every fused train program ``fit`` would need
         BEFORE training, via ``.lower().compile()`` — so step 1 of each
         shape dispatches a ready executable instead of stalling on XLA
@@ -2546,8 +2572,64 @@ class FeedForward(BASE_ESTIMATOR):
                 plan_label=plan_label, plan=plan)
             telemetry_mod.memory.preflight(entries, hbm_budget,
                                            what="precompile")
+        # opt-in shard audit over the EXACT warmed executables (ISSUE 16):
+        # shard_audit=True / MXNET_TPU_SHARD_AUDIT raises on MX802 drift;
+        # shard_audit="report" collects findings without raising (the
+        # --shardcheck CLI path)
+        from .analysis.sharding import shard_audit_enabled
+        report_only = shard_audit == "report"
+        shard_reports = []
+        if (report_only or shard_audit_enabled(shard_audit)) \
+                and mesh is not None:
+            flat_elems = sum(int(np.prod(self.arg_params[k].shape))
+                             for k in param_names)
+            for tj, args in jobs:
+                shard_reports.append(self._shard_audit_program(
+                    tj, args, mesh=mesh, comm_spec=comm_spec,
+                    overlap_plan=overlap_plan, flat_elems=flat_elems,
+                    raise_on_error=not report_only))
         return {"programs": len(jobs), "wall_seconds": wall,
-                "labels": [tj.label for tj, _ in jobs]}
+                "labels": [tj.label for tj, _ in jobs],
+                "shard_audit": shard_reports}
+
+    def _shard_audit_program(self, tracked, args, *, mesh, comm_spec,
+                             overlap_plan, flat_elems, raise_on_error=True,
+                             logger=None):
+        """mxlint Pass 5 over ONE step program (analysis/sharding.py):
+        trace-level MX801/MX803, and MX802 reconciliation of the warmed
+        executable's optimized HLO against the SAME closed-form plan the
+        program registers with the comm registry at first dispatch
+        (overlap_plan.wire_plan() / allreduce_plan). ``args`` may be
+        ShapeDtypeStructs (precompile) or the concrete placed step
+        arguments (fit's pre-dispatch hook — the audit warms the
+        TrackedJit for that signature, so the step it vouches for is the
+        step that runs). Raises MXNetError on error-severity findings
+        when ``raise_on_error``."""
+        from . import comm as comm_mod
+        from .analysis import sharding as shard_mod
+
+        log = logger or logging
+        ndev = int(mesh.shape["dp"])
+        plan = None
+        if ndev > 1:
+            plan = (overlap_plan.wire_plan() if overlap_plan is not None
+                    else comm_mod.allreduce_plan(flat_elems, ndev,
+                                                 comm_spec))
+        report = shard_mod.audit_step_program(
+            args=args, tracked=tracked, plan=plan, compression=comm_spec,
+            mesh=mesh)
+        for f in report.findings:
+            log.warning("shard audit [%s]: %s", tracked.label, f.format())
+        if raise_on_error and report.errors:
+            first = report.errors[0]
+            raise MXNetError(
+                f"shard audit [{tracked.label}]: the compiled step's "
+                f"collective set drifted from the declared comm plan "
+                f"({len(report.errors)} error(s); first: {first.rule.id} "
+                f"{first.message}). Fix the drift or disable the gate "
+                f"(shard_audit=False / unset MXNET_TPU_SHARD_AUDIT); see "
+                f"doc/developer-guide/static_analysis.md, Pass 5")
+        return report
 
     @staticmethod
     def _chaos_step_sites(batch_arrays, data_names, watchdog):

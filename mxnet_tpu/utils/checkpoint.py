@@ -339,7 +339,7 @@ def load_resharded(directory, mesh, step=None):
     params, aux, symbol, meta, opt_leaves, comm_state = load_sharded(
         directory, step, with_comm=True)
     repl = NamedSharding(mesh, P())
-    params = {k: jax.device_put(np.asarray(v), repl)
+    params = {k: jax.device_put(np.asarray(v), repl)  # mxlint: disable=MX805 - checkpoint restore replicates onto the mesh before the partitioner re-places
               for k, v in params.items()}
-    aux = {k: jax.device_put(np.asarray(v), repl) for k, v in aux.items()}
+    aux = {k: jax.device_put(np.asarray(v), repl) for k, v in aux.items()}  # mxlint: disable=MX805 - checkpoint restore replicates onto the mesh before the partitioner re-places
     return params, aux, symbol, meta, opt_leaves, comm_state

@@ -504,6 +504,21 @@ class TrackedJit:
     def aot_programs(self):
         return len(self._aot)
 
+    @property
+    def jitted(self):
+        """The underlying ``jax.jit`` object — the traceable surface for
+        read-only consumers (``jax.make_jaxpr`` in the shard audit); call
+        through the TrackedJit itself to keep registry accounting."""
+        return self._jitted
+
+    def optimized_hlo(self, *args, **kwargs) -> str:
+        """Optimized-HLO text of the warmed program for this signature —
+        AOT-compiling it first if needed (idempotent, registry-priced).
+        This is what the mxlint Pass 5 collective reconciliation audits:
+        the text of the EXACT executable signature-matched dispatch will
+        run, not a fresh re-lowering."""
+        return self.precompile(*args, **kwargs).as_text()
+
     def is_warm(self, *args, **kwargs) -> bool:
         """Is an AOT executable already registered for this argument
         signature? The elastic resize path asks this before re-warming:

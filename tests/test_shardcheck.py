@@ -1,0 +1,587 @@
+"""mxlint Pass 5 — the sharding audit of the lowered program (ISSUE 16).
+
+Four layers of coverage, mirroring the pass's own structure:
+
+- GOLDEN collective tables: the dp-8 gradient exchange compiled under
+  every compression tier (none/bf16/int8/twobit) x overlap on/off must
+  reconcile against its closed-form plan with ZERO MX802 drift, and the
+  faithful-dtype payloads (s8/u8/f32) must match the plan's element
+  counts EXACTLY (==, not approx). bf16 payloads are upcast to f32 by
+  the CPU backend; the audit matches them via ``allow_widen`` and
+  reports each in ``widened`` — never silently.
+- SEEDED violations: every rule (MX801-MX805) has a fixture it must
+  fire on and a near-miss it must stay silent on. The MX802 fixtures
+  cross-audit programs against the WRONG plan (compression dropped /
+  unplanned collectives / element-count drift).
+- The RUNTIME gate: ``precompile(shard_audit=...)`` report and raise
+  paths, the ``MXNET_TPU_SHARD_AUDIT`` env resolution.
+- The TIER-1 SELF-AUDIT: ``selfcheck_report()`` — the repo's own dp-8
+  full-stack fused step (int8 + overlap + comm kernels + health +
+  guards) audits clean. This is the shipped contract behind
+  ``python -m mxnet_tpu.analysis --shardcheck``.
+
+Plus the CLI surfaces: ``--list-rules`` carries the MX80x band, findings
+dedup across passes, and the ``--baseline`` CI flow exits 3 exactly when
+NEW violations appear. Runs on conftest's 8-virtual-CPU-device rig.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import comm
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.compat import shard_map
+from mxnet_tpu.analysis import main as mxlint_main
+from mxnet_tpu.analysis.rules import RULES, Finding, get_rule
+from mxnet_tpu.analysis.sharding import (
+    DEFAULT_MIN_REPLICATED_BYTES, ShardAuditReport, audit_collective_drift,
+    audit_jaxpr_sharding, audit_step_program, check_partition_specs,
+    expected_collectives, selfcheck_report, shard_audit_enabled)
+from mxnet_tpu.analysis.source_lint import lint_paths, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+L = 8192  # flat gradient elements for the golden exchange
+
+
+def _mesh8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.array(devs[:8]), ("dp",))
+
+
+def _exchange_hlo(mode, overlap):
+    """Compile the dp-8 gradient exchange one way; return (hlo, plan).
+
+    ``overlap`` with a real compression tier uses the bucketed
+    overlap_allreduce and its wire_plan(); mode None has no overlapped
+    form (plan_overlap refuses — the schedule pipelines the *quantized*
+    sync), so that cell compiles the fused psum and audits it against
+    allreduce_plan, which is exactly what fit() runs for that config.
+    """
+    mesh = _mesh8()
+    g = np.random.RandomState(0).randn(8, L).astype(np.float32)
+    if overlap and mode is not None:
+        oplan = comm.plan_overlap({"w": (L,)}, mode, 8)
+        plan = oplan.wire_plan()
+        resid = comm.init_overlap_residuals(oplan)
+        if resid is None:  # bf16: no error feedback to carry
+
+            def body(gs):
+                out, _ = comm.overlap_allreduce(
+                    {"w": gs[0]}, None, oplan, "dp", average=True)
+                return out["w"][None]
+
+            f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"),
+                                  out_specs=P("dp"), check_vma=False))
+            return f.lower(g).compile().as_text(), plan
+
+        def body(gs, res):
+            out, res2 = comm.overlap_allreduce(
+                {"w": gs[0]}, res, oplan, "dp", average=True)
+            return out["w"][None], res2
+
+        f = jax.jit(shard_map(body, mesh=mesh,
+                              in_specs=(P("dp"), P("dp")),
+                              out_specs=(P("dp"), P("dp")),
+                              check_vma=False))
+        return f.lower(g, resid).compile().as_text(), plan
+
+    plan = comm.allreduce_plan(L, 8, mode)
+
+    def body(gs):
+        out = comm.compressed_allreduce({"w": gs[0]}, mode, "dp",
+                                        axis_size=8, average=True)
+        return out["w"][None]
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"),
+                          out_specs=P("dp"), check_vma=False))
+    return f.lower(g).compile().as_text(), plan
+
+
+# -- golden collective tables: 4 tiers x overlap on/off ------------------------
+
+@pytest.mark.parametrize("overlap", [False, True],
+                         ids=["fused", "overlap"])
+@pytest.mark.parametrize("mode", [None, "bf16", "int8", "twobit"])
+def test_golden_exchange_reconciles_exactly(mode, overlap):
+    """ACCEPTANCE: MX802 zero drift on every compression x overlap cell,
+    with EXACT (==) element equality for every faithfully-lowered dtype
+    and explicit ``widened`` rows (never silent) for the CPU backend's
+    bf16->f32 payload normalization."""
+    hlo, plan = _exchange_hlo(mode, overlap)
+    findings, report = audit_collective_drift(hlo, plan, compression=mode)
+    assert findings == [], "\n".join(f.format() for f in findings)
+    assert report["unplanned"] == []
+    # every planned group resolved, each to the exact element count
+    resolved = report["matched"] + report["widened"]
+    assert len(resolved) == len(report["expected"])
+    for row in resolved:
+        assert row["hlo_elements"] == row["expected_elements"], row
+    # faithful dtypes match at their own width; widening only ever
+    # explains a bf16/f16 plan row
+    for row in report["matched"]:
+        assert row["hlo_dtype"] == row["dtype"]
+    for row in report["widened"]:
+        assert row["dtype"] in ("bf16", "f16") and \
+            row["hlo_dtype"] == "f32", row
+    # the bare exchange has no loss/metric scalars: nothing unexplained
+    assert report["stat_rows"] == []
+
+
+def test_hlo_collective_rows_structure():
+    """SATELLITE (a): per-collective rows expose op kind, replica-group
+    shape, and element dtype — the evidence surface MX802 consumes."""
+    hlo, _ = _exchange_hlo("int8", False)
+    rows = comm.hlo_collective_rows(hlo, 8)
+    assert rows, "int8 exchange must contain collectives"
+    for r in rows:
+        assert set(r) >= {"op", "async", "payload_bytes", "wire_bytes",
+                          "group_size", "replica_groups", "parts"}
+        assert r["group_size"] == 8
+        for part in r["parts"]:
+            assert set(part) == {"dtype", "elements", "bytes"}
+    ops = {r["op"] for r in rows}
+    assert ops >= {"all-to-all", "all-gather"}
+    dtypes = {p["dtype"] for r in rows for p in r["parts"]}
+    assert "s8" in dtypes, f"int8 codes must be visible on the wire: {dtypes}"
+    table = comm.hlo_collective_table(hlo, 8)
+    for trow in table:
+        assert set(trow) >= {"op", "count", "payload_bytes", "wire_bytes",
+                             "elements", "dtypes", "replica_groups"}
+
+
+def test_expected_collectives_rejects_mode_mismatch():
+    plan = comm.allreduce_plan(L, 8, "int8")
+    with pytest.raises(ValueError, match="does not match plan mode"):
+        expected_collectives(plan, compression="bf16")
+
+
+# -- MX802 seeded drift --------------------------------------------------------
+
+def test_mx802_fires_when_compression_silently_dropped():
+    """The plan says int8 (a2a + ag of codes and scales) but the program
+    lowered the uncompressed psum: every planned collective is missing
+    AND the full-size f32 all-reduce is unplanned."""
+    hlo, _ = _exchange_hlo(None, False)
+    plan = comm.allreduce_plan(L, 8, "int8")
+    # the 8192-element f32 sync is 32 KiB — drop the stat allowance
+    # below it so the unplanned op is named, not absorbed
+    findings, report = audit_collective_drift(hlo, plan,
+                                              compression="int8",
+                                              small_allreduce_bytes=1024)
+    assert findings and all(f.rule.id == "MX802" for f in findings)
+    assert all(f.is_error for f in findings)
+    msgs = " | ".join(f.message for f in findings)
+    assert "missing" in msgs
+    assert "unplanned all-reduce" in msgs  # the fp32 sync sneaking back
+    assert report["unplanned"], "full-size all-reduce must be named"
+
+
+def test_mx802_fires_on_unplanned_compressed_collectives():
+    """Converse drift: the program compresses but the plan says plain
+    all-reduce — every all-to-all/all-gather on the wire is named."""
+    hlo, _ = _exchange_hlo("int8", False)
+    plan = comm.allreduce_plan(L, 8, None)
+    findings, _ = audit_collective_drift(hlo, plan)
+    named_ops = {f.node.split(":")[0] for f in findings
+                 if "unplanned" in f.message}
+    assert {"all-to-all", "all-gather"} <= named_ops
+
+
+def test_mx802_fires_on_element_count_drift():
+    """Same op set, wrong payload size (the plan describes a larger
+    parameter count than the program syncs) — the per-(op,dtype)
+    element totals disagree and no allowance can absorb a SHORTFALL."""
+    hlo, _ = _exchange_hlo(None, False)
+    plan = comm.allreduce_plan(2 * L, 8, None)
+    findings, _ = audit_collective_drift(hlo, plan)
+    assert findings
+    assert any("expects" in f.message and "moves" in f.message
+               for f in findings)
+
+
+_GROUPS8 = "replica_groups={{0,1,2,3,4,5,6,7}}"
+_SYNTH_GRAD = ("  %ar.1 = f32[8192]{0} all-reduce(f32[8192]{0} %x), "
+               + _GROUPS8 + "\n")
+_SYNTH_STAT_F32 = ("  %ar.2 = f32[8]{0} all-reduce(f32[8]{0} %y), "
+                   + _GROUPS8 + "\n")
+_SYNTH_STAT_S32 = ("  %ar.3 = s32[4]{0} all-reduce(s32[4]{0} %z), "
+                   + _GROUPS8 + "\n")
+
+
+def test_mx802_small_allreduce_allowance_is_bounded():
+    """The step's own bookkeeping scalars (loss psum, guard counters)
+    are allowed under the threshold — via BOTH shapes they lower to: a
+    same-dtype scalar merged into the planned gradient all-reduce
+    (extra elements), and a separate small all-reduce of another dtype
+    (stat row). One byte past the threshold, each becomes drift."""
+    plan = comm.allreduce_plan(8192, 8, None)
+    hlo = _SYNTH_GRAD + _SYNTH_STAT_F32 + _SYNTH_STAT_S32
+    findings, report = audit_collective_drift(hlo, plan)
+    assert findings == [], "\n".join(f.format() for f in findings)
+    # the f32 scalars merged into the planned group: extra elements
+    (m,) = report["matched"]
+    assert m["stat_elements"] == 8
+    # the s32 guard counters stayed a separate tiny all-reduce: stat row
+    (s,) = report["stat_rows"]
+    assert (s["dtype"], s["elements"], s["bytes"]) == ("s32", 4, 16)
+    # threshold is a hard bound: 32 extra f32 bytes vs a 31-byte allowance
+    findings31, _ = audit_collective_drift(hlo, plan,
+                                           small_allreduce_bytes=31)
+    msgs = " | ".join(f.message for f in findings31)
+    assert "expects 8192" in msgs and "moves 8200" in msgs
+    # and 16 s32 bytes vs a 15-byte allowance
+    findings15, _ = audit_collective_drift(
+        _SYNTH_GRAD + _SYNTH_STAT_S32, plan, small_allreduce_bytes=15)
+    assert any("unplanned all-reduce" in f.message for f in findings15)
+
+
+# -- MX801 / MX803 seeded jaxprs ----------------------------------------------
+
+def test_mx801_fires_on_large_replicated_constraint():
+    mesh = _mesh8()
+    big = jnp.zeros((1024, 1024), jnp.float32)  # 4 MiB >= 1 MiB threshold
+
+    def f(x):
+        return jax.lax.with_sharding_constraint(
+            x * 2.0, NamedSharding(mesh, P()))
+
+    closed = jax.make_jaxpr(f)(big)
+    findings = audit_jaxpr_sharding(closed, axis_sizes={"dp": 8})
+    assert [f_.rule.id for f_ in findings] == ["MX801"]
+    assert "replicated" in findings[0].message
+    assert findings[0].extra["bytes"] == 4 * 1024 * 1024
+
+
+def test_mx801_silent_on_small_or_sharded_or_single_device():
+    mesh = _mesh8()
+    small = jnp.zeros((8, 8), jnp.float32)
+    big = jnp.zeros((1024, 1024), jnp.float32)
+
+    def repl_small(x):
+        return jax.lax.with_sharding_constraint(
+            x * 2.0, NamedSharding(mesh, P()))
+
+    def sharded_big(x):
+        return jax.lax.with_sharding_constraint(
+            x * 2.0, NamedSharding(mesh, P("dp")))
+
+    assert audit_jaxpr_sharding(jax.make_jaxpr(repl_small)(small),
+                                axis_sizes={"dp": 8}) == []
+    assert audit_jaxpr_sharding(jax.make_jaxpr(sharded_big)(big),
+                                axis_sizes={"dp": 8}) == []
+    # dp=1: replication is free, no finding even on the big tensor
+    assert audit_jaxpr_sharding(jax.make_jaxpr(repl_small)(big),
+                                axis_sizes={"dp": 1}) == []
+
+
+def test_mx803_fires_on_collective_in_scan_body():
+    mesh = _mesh8()
+
+    def body(xs):
+        def scan_step(carry, x):
+            return carry + jax.lax.psum(x, "dp"), None
+
+        out, _ = jax.lax.scan(scan_step, jnp.zeros(()), xs[0])
+        return jax.lax.psum(out, "dp")[None]  # one-shot: must NOT fire
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P(),
+                  check_vma=False)
+    closed = jax.make_jaxpr(f)(np.zeros((8, 16), np.float32))
+    findings = audit_jaxpr_sharding(closed, axis_sizes={"dp": 8})
+    mx803 = [f_ for f_ in findings if f_.rule.id == "MX803"]
+    assert len(mx803) == 1, [f_.format() for f_ in findings]
+    assert "scan" in mx803[0].message
+    assert "EVERY iteration" in mx803[0].message
+
+
+def test_mx803_silent_on_one_shot_collectives():
+    mesh = _mesh8()
+
+    def body(xs):
+        return jax.lax.psum(xs.sum(), "dp")[None]
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P(),
+                  check_vma=False)
+    closed = jax.make_jaxpr(f)(np.zeros((8, 16), np.float32))
+    assert audit_jaxpr_sharding(closed, axis_sizes={"dp": 8}) == []
+
+
+# -- MX804 seeded specs --------------------------------------------------------
+
+def test_mx804_fires_on_unknown_axis_and_unsharded_batch():
+    findings = check_partition_specs(
+        {"w": P("tp"), "data": P(None, None)},
+        {"dp": 8}, batch=("data",))
+    ids = sorted(f.rule.id for f in findings)
+    assert ids == ["MX804", "MX804"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "'tp'" in msgs and "unsharded" in msgs
+    assert all(f.is_error for f in findings)
+
+
+def test_mx804_silent_on_clean_specs():
+    mesh = _mesh8()
+    assert check_partition_specs(
+        {"w": P(), "data": P("dp")}, mesh, batch=("data",)) == []
+    # dp=1 mesh: an unsharded batch is fine
+    assert check_partition_specs(
+        {"data": P(None)}, {"dp": 1}, batch=("data",)) == []
+
+
+# -- MX805 source fixtures -----------------------------------------------------
+
+_MX805_SRC = (
+    "import jax\n"
+    "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+    "def place(x, mesh):\n"
+    "    sh = NamedSharding(mesh, P())\n"
+    "    a = jax.device_put(x, sh)\n"
+    "    b = jax.device_put(x, NamedSharding(mesh, P('dp')))\n"
+    "    shards = {k: NamedSharding(mesh, P()) for k in ('w', 'b')}\n"
+    "    c = jax.device_put(x, shards['w'])\n"
+    "    d = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))\n"
+    "    return a, b, c, d\n")
+
+
+def test_mx805_fires_on_placement_outside_owner_layers():
+    findings = [f for f in lint_source(_MX805_SRC,
+                                       "mxnet_tpu/models/foo.py")
+                if f.rule.id == "MX805"]
+    # named sharding, inline sharding, dict-comprehension subscript,
+    # and the raw constraint: four distinct sites
+    assert len(findings) == 4, [f.format() for f in findings]
+
+
+def test_mx805_silent_in_owner_layers_and_on_device_placement():
+    for owner in ("mxnet_tpu/parallel/foo.py", "mxnet_tpu/comm/foo.py"):
+        assert [f for f in lint_source(_MX805_SRC, owner)
+                if f.rule.id == "MX805"] == []
+    src = ("import jax\n"
+           "def place(x, dev):\n"
+           "    return jax.device_put(x, dev)\n")  # a Device, not a sharding
+    assert [f for f in lint_source(src, "mxnet_tpu/models/foo.py")
+            if f.rule.id == "MX805"] == []
+
+
+def test_mx805_pragma_suppression_with_justification():
+    src = ("import jax\n"
+           "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+           "def restore(x, mesh):\n"
+           "    return jax.device_put(x, NamedSharding(mesh, P()))"
+           "  # mxlint: disable=MX805 - checkpoint restore\n")
+    assert [f for f in lint_source(src, "mxnet_tpu/models/foo.py")
+            if f.rule.id == "MX805"] == []
+
+
+def test_self_lint_mx805_clean():
+    """The tree itself keeps placement inside parallel/ + comm/; each
+    deliberate exception carries a justified pragma."""
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX805"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+# -- the runtime gate ----------------------------------------------------------
+
+def test_shard_audit_enabled_resolution(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_SHARD_AUDIT", raising=False)
+    assert shard_audit_enabled() is False
+    assert shard_audit_enabled(True) is True
+    assert shard_audit_enabled(False) is False
+    for off in ("", "0", "false", "off", "no"):
+        monkeypatch.setenv("MXNET_TPU_SHARD_AUDIT", off)
+        assert shard_audit_enabled() is False
+    monkeypatch.setenv("MXNET_TPU_SHARD_AUDIT", "1")
+    assert shard_audit_enabled() is True
+    assert shard_audit_enabled(False) is False  # explicit arg wins
+
+
+def _small_model(ctx):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=16)
+    net = sym.Activation(data=net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=2)
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+    return mx.FeedForward(net, ctx=ctx, num_epoch=1, learning_rate=0.5)
+
+
+def test_precompile_shard_audit_raises_on_seeded_error(monkeypatch):
+    """The gate's contract: an error-severity finding in the report
+    aborts precompile(shard_audit=True) BEFORE any step could run,
+    naming the rule; shard_audit='report' returns the same findings
+    without raising."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from mxnet_tpu.analysis import sharding as shard_mod
+
+    seeded = ShardAuditReport(findings=[Finding(
+        get_rule("MX802"), "seeded drift for the raise-path test",
+        node="all-gather:s8")])
+    monkeypatch.setattr(shard_mod, "audit_step_program",
+                        lambda *a, **k: seeded)
+    model = _small_model([mx.cpu(i) for i in range(8)])
+    kw = dict(data_shapes={"data": (16, 4)},
+              label_shapes={"softmax_label": (16,)},
+              compression="int8")
+    with pytest.raises(MXNetError, match="MX802"):
+        model.precompile(shard_audit=True, **kw)
+    out = _small_model([mx.cpu(i) for i in range(8)]).precompile(
+        shard_audit="report", **kw)
+    assert out["shard_audit"], "report mode must still collect findings"
+    assert any(f.rule.id == "MX802"
+               for rep in out["shard_audit"] for f in rep.findings)
+
+
+def test_precompile_shard_audit_report_clean_on_real_program():
+    """The real (un-seeded) small int8 program audits clean through the
+    precompile gate — the report path returns evidence, not findings."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    model = _small_model([mx.cpu(i) for i in range(8)])
+    out = model.precompile(data_shapes={"data": (16, 4)},
+                           label_shapes={"softmax_label": (16,)},
+                           compression="int8", shard_audit="report")
+    reports = out["shard_audit"]
+    assert reports
+    for rep in reports:
+        assert rep.findings == [], \
+            "\n".join(f.format() for f in rep.findings)
+        assert rep.reconciliation.get("matched"), \
+            "audit must show evidence it reconciled, not just silence"
+
+
+def test_fit_shard_audit_gate_runs_and_trains(monkeypatch):
+    """The fit-loop hook: with shard_audit=True the warmed program is
+    audited once per batch signature before its first dispatch, and a
+    clean program trains normally. The audit call is observed through
+    the same audit_step_program the CLI uses."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from mxnet_tpu.analysis import sharding as shard_mod
+
+    calls = []
+    real = shard_mod.audit_step_program
+
+    def spy(*a, **k):
+        rep = real(*a, **k)
+        calls.append(rep)
+        return rep
+
+    monkeypatch.setattr(shard_mod, "audit_step_program", spy)
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    model = _small_model([mx.cpu(i) for i in range(8)])
+    model.fit(X, y, batch_size=32, compression="int8", shard_audit=True)
+    assert calls, "fit(shard_audit=True) must audit the warmed program"
+    for rep in calls:
+        assert rep.errors == [], \
+            "\n".join(f.format() for f in rep.errors)
+    assert model.arg_params  # trained through the gate
+
+
+# -- the tier-1 self-audit -----------------------------------------------------
+
+def test_selfcheck_full_stack_dp8_zero_findings():
+    """ACCEPTANCE: the repo's own dp-8 full-stack fused step (int8 +
+    overlap + fused comm kernels + health stats + guards) audits clean
+    — the --shardcheck CLI target. Evidence-bearing: the report must
+    show exact matched rows, not a skipped audit."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    rep = selfcheck_report()
+    assert rep.findings == [], "\n".join(f.format() for f in rep.findings)
+    rec = rep.reconciliation
+    assert rec.get("matched"), "reconciliation must have matched rows"
+    assert rec.get("unplanned") == []
+    for row in rec["matched"] + rec["widened"]:
+        assert row["hlo_elements"] >= row["expected_elements"]
+    assert not rep.errors
+
+
+# -- registry / docs / CLI parity ----------------------------------------------
+
+def test_mx80x_registry_docs_and_list_rules_agree(capsys):
+    """SATELLITE (f): every MX80x rule exists in the registry, appears in
+    the static_analysis.md catalog, and is printed by --list-rules —
+    drift in any direction fails."""
+    band = sorted(r for r in RULES if r.startswith("MX8"))
+    assert band == ["MX801", "MX802", "MX803", "MX804", "MX805"]
+    doc = open(os.path.join(
+        REPO, "doc", "developer-guide", "static_analysis.md"),
+        encoding="utf-8").read()
+    for rid in band:
+        assert f"| {rid} |" in doc, f"{rid} missing from the rule catalog"
+    assert "MXNET_TPU_SHARD_AUDIT" in open(
+        os.path.join(REPO, "doc", "env_var.md"), encoding="utf-8").read()
+    rc = mxlint_main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for rid in band:
+        assert rid in out
+    # severities in the listing match the registry
+    assert RULES["MX802"].severity == "error"
+    assert RULES["MX804"].severity == "error"
+
+
+def test_cli_dedups_findings_across_duplicate_inputs(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    try:\n        pass\n"
+                   "    except:\n        pass\n")
+    rc = mxlint_main([str(bad), str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1  # MX601 is error severity
+    assert out.count("MX601") == 1, out
+
+
+def test_cli_baseline_flow_exits_3_only_on_new(tmp_path, capsys):
+    """SATELLITE (CI surface): first run seeds the baseline (exit 0),
+    an unchanged tree compares clean (exit 0), and a NEW violation —
+    and only the new one — is reported with exit 3."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    try:\n        pass\n"
+                   "    except:\n        pass\n")
+    base = tmp_path / "lint_baseline.json"
+    assert mxlint_main([str(bad), "--baseline", str(base)]) == 0
+    assert json.loads(base.read_text()), "baseline must record the finding"
+    capsys.readouterr()
+    assert mxlint_main([str(bad), "--baseline", str(base)]) == 0
+    out = capsys.readouterr().out
+    assert "0 new vs baseline" in out
+    worse = tmp_path / "worse.py"
+    worse.write_text("def g():\n    try:\n        pass\n"
+                     "    except:\n        pass\n")
+    rc = mxlint_main([str(bad), str(worse), "--baseline", str(base),
+                      "--ci"])
+    out = capsys.readouterr().out
+    assert rc == 3
+    rows = [ln for ln in out.splitlines() if ln.startswith("MX")]
+    assert len(rows) == 1 and "worse.py" in rows[0], out
+    cols = rows[0].split("\t")
+    assert cols[0] == "MX601" and cols[1] == "error"
+
+
+def test_audit_step_program_notes_when_plan_missing():
+    """Sub-checks that cannot run are recorded, never silently skipped."""
+    mesh = _mesh8()
+
+    def body(xs):
+        return jax.lax.psum(xs.sum(), "dp")[None]
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"),
+                          out_specs=P(), check_vma=False))
+    x = np.zeros((8, 16), np.float32)
+    rep = audit_step_program(f, (x,), hlo_text=f.lower(x).compile()
+                             .as_text(), mesh=mesh)
+    assert any("MX802 skipped" in n for n in rep.notes)
+    assert rep.table, "collective table still collected without a plan"
