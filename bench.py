@@ -2329,6 +2329,189 @@ def run_lockwatch_bench(args):
         print(f"wrote {out_path}", file=sys.stderr)
 
 
+def run_ckpt_bench(args):
+    """--ckpt-bench: price the async multi-tier checkpoint plane
+    (ISSUE 17) on the dp-8 CPU mesh. Three measurements:
+
+      1. the step-loop stall per checkpoint — the T0 capture+submit wall
+         (one blocking device->host copy, writer thread owns the rest)
+         vs the synchronous durable save wall on the same training state.
+         Acceptance: async stall < 10% of the sync wall.
+      2. the recovery wall on an 8 -> 6 elastic resize: peer (T1, RAM)
+         restore vs a chaos-forced disk (T2) restore of the same run.
+      3. checkpoint badput per epoch at three cadences (every 1/4/16
+         steps), as priced by the epoch goodput report.
+
+    Emits one JSON line; full runs write BENCH_CKPT_r19.json."""
+    import statistics
+    import tempfile
+    import time as _time
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.resilience import (ElasticCoordinator, chaos_scope,
+                                      ckpt_async)
+    from mxnet_tpu.utils import checkpoint as ckpt_mod
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    world = 8
+    if len(jax.devices()) < world:
+        print(json.dumps({"metric": "ckpt_async_stall_pct_of_sync",
+                          "value": 0, "unit": "%", "vs_baseline": 0,
+                          "error": f"need {world} devices"}))
+        return
+    smoke = args.smoke
+    dim, hidden, classes = (32, 64, 4) if smoke else (256, 1024, 32)
+    batch, n_rows = (48, 480) if smoke else (192, 3840)
+    reps = 5 if smoke else 20
+
+    def build(epochs):
+        data = mx.sym.Variable("data")
+        h1 = mx.sym.Activation(mx.sym.FullyConnected(
+            data, name="fc1", num_hidden=hidden), name="a1",
+            act_type="tanh")
+        out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+            h1, name="fc2", num_hidden=classes), name="softmax")
+        return mx.FeedForward(out, ctx=[mx.cpu(i) for i in range(world)],
+                              num_epoch=epochs, optimizer="sgd",
+                              learning_rate=0.05)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_rows, dim).astype(np.float32)
+    y = rng.randint(0, classes, (n_rows,)).astype(np.float32)
+    steps_per_epoch = n_rows // batch
+    os.environ.setdefault("MXNET_TPU_CKPT_KEEP", "0")  # GC out of the timing
+
+    # -- 1. per-checkpoint step stall: T0 capture+submit vs sync save ------
+    tmp = tempfile.mkdtemp(prefix="mxtpu_ckpt_bench_")
+    d_state = os.path.join(tmp, "state")
+    model = build(1)
+    model.fit(X, y, batch_size=batch, sharded_checkpoint_dir=d_state)
+    loaded, laux, _, _, opt_leaves = ckpt_mod.load_sharded(d_state)
+    mesh = make_mesh(dp=world)
+    repl = NamedSharding(mesh, P())
+    params = {k: jax.device_put(np.asarray(v), repl)
+              for k, v in loaded.items()}
+    opt = None if opt_leaves is None else \
+        [jax.device_put(np.asarray(l), repl) for l in opt_leaves]
+
+    d_async = os.path.join(tmp, "async")
+    writer = ckpt_async.AsyncCheckpointWriter(d_async, queue_depth=2,
+                                              keep_last_k=0)
+    async_ms, step_id = [], 0
+    try:
+        for _ in range(reps):
+            step_id += 1
+            t0 = _time.perf_counter()
+            snap = ckpt_async.capture_snapshot(
+                step_id, params, opt_state=opt,
+                meta={"num_update": step_id})
+            writer.submit(snap)
+            async_ms.append((_time.perf_counter() - t0) * 1e3)
+            writer.flush(timeout=120)  # drain OUTSIDE the stall timer
+    finally:
+        writer.close()
+    d_sync = os.path.join(tmp, "sync")
+    sync_ms = []
+    for _ in range(reps):
+        step_id += 1
+        t0 = _time.perf_counter()
+        ckpt_async.save_now(d_sync, step_id, params, opt_state=opt,
+                            extra_meta={"num_update": step_id})
+        sync_ms.append((_time.perf_counter() - t0) * 1e3)
+    async_stall = statistics.median(async_ms)
+    sync_wall = statistics.median(sync_ms)
+    stall_pct = 100.0 * async_stall / sync_wall if sync_wall else None
+
+    # -- 2. resize recovery wall: peer (T1) vs chaos-forced disk (T2) ------
+    def resize_run(chaos_rules=None):
+        telemetry.reset()
+        co = ElasticCoordinator(world)
+
+        def drive(param):
+            if param.epoch == 1 and param.nbatch == 2 and \
+                    co.world_size == world:
+                co.kill()
+                co.kill()
+
+        m = build(3)
+        d = tempfile.mkdtemp(prefix="mxtpu_ckpt_bench_el_")
+        kw = dict(batch_size=batch, elastic=co, sharded_checkpoint_dir=d,
+                  checkpoint_every_n_steps=2, batch_end_callback=drive)
+        it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False)
+        if chaos_rules:
+            with chaos_scope(seed=0, rules=chaos_rules):
+                m.fit(it, **kw)
+        else:
+            m.fit(it, **kw)
+        assert co.resizes == 1
+        events = telemetry.hub().events("checkpoint")
+        tier = "t1" if any(e.get("tier") == "t1" for e in events) else "t2"
+        return co.history[0]["downtime_s"], tier
+
+    peer_recovery_s, peer_tier = resize_run()
+    disk_recovery_s, disk_tier = resize_run({"ckpt.replica": 1.0})
+
+    # -- 3. checkpoint badput per epoch at three cadences ------------------
+    badput_by_cadence = {}
+    for every in (1, 4, 16):
+        telemetry.reset()
+        jsonl = os.path.join(tmp, f"events_{every}.jsonl")
+        m = build(2)
+        m.fit(mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False),
+              batch_size=batch,
+              sharded_checkpoint_dir=os.path.join(tmp, f"cad{every}"),
+              checkpoint_every_n_steps=every,
+              telemetry=telemetry.TelemetryConfig(jsonl=jsonl))
+        events = telemetry.read_events(jsonl)
+        ckpt_s = [float(e.get("seconds", 0.0)) for e in events
+                  if e.get("kind") == "badput"
+                  and e.get("reason") == "checkpoint"]
+        walls = [float(e.get("seconds", 0.0)) for e in events
+                 if e.get("kind") == "epoch_summary"]
+        per_epoch = sum(ckpt_s) / max(1, len(walls))
+        badput_by_cadence[str(every)] = {
+            "badput_s_per_epoch": round(per_epoch, 4),
+            "badput_pct_of_wall": round(
+                100.0 * sum(ckpt_s) / sum(walls), 2) if sum(walls) else None,
+        }
+
+    result = {
+        "metric": "ckpt_async_stall_pct_of_sync",
+        "value": round(stall_pct, 2) if stall_pct is not None else None,
+        "unit": "%",
+        "vs_baseline": round(sync_wall, 3),
+        "async_stall_ms": round(async_stall, 3),
+        "sync_save_ms": round(sync_wall, 3),
+        "peer_recovery_s": round(peer_recovery_s, 4),
+        "disk_recovery_s": round(disk_recovery_s, 4),
+        "peer_recovery_tier": peer_tier,
+        "disk_recovery_tier": disk_tier,
+        "badput_by_cadence": badput_by_cadence,
+        "reps": reps, "steps_per_epoch": steps_per_epoch,
+        "batch": batch, "world": world,
+        "smoke": bool(smoke),
+        "notes": (
+            "headline = the step-loop stall per checkpoint (T0 capture+"
+            "submit) as % of the synchronous durable save wall on the "
+            "same state; acceptance <10%. peer vs disk recovery is the "
+            "8->6 resize downtime with the T1 RAM tier live vs chaos-"
+            "killed (ckpt.replica) forcing the T2 disk read. badput rows "
+            "are the epoch goodput report's `checkpoint` bucket."),
+    }
+    print(json.dumps(result))
+    if not smoke:
+        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_CKPT_r19.json")
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(f"wrote {out_path}", file=sys.stderr)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=256)
@@ -2374,6 +2557,13 @@ def main():
                          "to 8) and post-resize goodput on the CPU mesh; "
                          "emits one JSON line, full runs write "
                          "BENCH_ELASTIC_r13.json")
+    ap.add_argument("--ckpt-bench", action="store_true",
+                    help="async multi-tier checkpoint plane (ISSUE 17): "
+                         "T0 capture+submit stall vs sync save wall "
+                         "(acceptance <10%%), peer (RAM) vs disk recovery "
+                         "on a dp-8 resize, checkpoint badput at 3 "
+                         "cadences -> BENCH_CKPT_r19.json (one JSON line "
+                         "with --smoke)")
     ap.add_argument("--controller-bench", action="store_true",
                     help="fleet-controller acceptance (ISSUE 12): inject "
                          "a persistent straggler + flaky rank into dp-8 "
@@ -2533,6 +2723,17 @@ def main():
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8").strip()
         run_lockwatch_bench(args)
+        return
+
+    if args.ckpt_bench:
+        # same CPU-mesh rig: the snapshot stall, writer drain and both
+        # recovery tiers are host+virtual-world paths, no hardware needed
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        run_ckpt_bench(args)
         return
 
     if args.elastic_bench:

@@ -283,6 +283,23 @@ register_rule(
     "`# mxlint: disable=MX314` with a justification")
 
 register_rule(
+    "MX315", "warning",
+    "direct sharded-checkpoint write (`save_sharded` / `_save_sharded` / "
+    "`_write_manifest`) outside utils/checkpoint.py / "
+    "resilience/ckpt_async.py: the async checkpoint plane owns durability "
+    "ordering — tmp-dir staging, CRC manifest commit, retention GC and "
+    "the writer-thread flush barriers that keep synchronous saves from "
+    "racing an in-flight async write of the same step; a stray direct "
+    "write bypasses the `checkpoint` badput pricing and telemetry "
+    "gauges, can interleave with the writer on the same `.tmp.<step>` "
+    "dir, and is invisible to keep-last-k retention",
+    "route saves through resilience.ckpt_async (AsyncCheckpointWriter"
+    ".submit for the async tier, ckpt_async.save_now for synchronous "
+    "barriers) or fit(sharded_checkpoint_dir=..., "
+    "checkpoint_every_n_steps=...); a deliberate direct write carries "
+    "`# mxlint: disable=MX315` with a justification")
+
+register_rule(
     "MX306", "warning",
     "un-barriered wall-clock delta around device dispatch: a "
     "time.time()/perf_counter() start/stop pair with work between and no "

@@ -38,6 +38,14 @@ Catches, before anything imports or traces:
                is process-global, so strays race the framework's bounded
                capture windows and a leaked trace breaks every later one
                (telemetry.profiling.capture() is the sanctioned shape),
+  MX315        direct sharded-checkpoint writes (save_sharded /
+               _write_manifest) outside utils/checkpoint.py /
+               resilience/ckpt_async.py — the checkpoint plane owns
+               durability ordering (tmp-dir staging, CRC commit,
+               retention GC, writer-thread flush barriers), so strays
+               race the async writer and dodge badput pricing
+               (ckpt_async.save_now / AsyncCheckpointWriter.submit are
+               the sanctioned shapes),
   MX601-602    robustness hazards (bare ``except:``; ``while True`` retry
                loops that swallow exceptions with no backoff/deadline —
                the loop shape that melts a parameter server under a
@@ -1181,6 +1189,51 @@ def _scan_profiler_discipline(tree, path, findings):
                 path=path, line=call.lineno, col=call.col_offset))
 
 
+# -- MX315: direct sharded-checkpoint writes outside the checkpoint plane -----
+# ISSUE 17: every durable write flows through utils/checkpoint.py (tmp-dir
+# staging + CRC manifest + atomic rename) driven by resilience/ckpt_async.py
+# (writer thread, flush barriers, keep-last-k GC, `checkpoint` badput
+# pricing). A `save_sharded(...)` call anywhere else can interleave with an
+# in-flight async write of the same step id and never shows up in the
+# telemetry gauges. Zero-FP-biased: fires on the bare call names only
+# (Name or Attribute receiver — `ckpt.save_sharded(...)` included); loads,
+# reads and `load_resharded` never match; tests/examples/fixtures exempt.
+
+_MX315_OWNER_FILES = ("checkpoint.py", "ckpt_async.py")
+_MX315_WRITE_NAMES = ("save_sharded", "_save_sharded", "_write_manifest")
+
+
+def _mx315_exempt(path: str) -> bool:
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if any(p in ("tests", "examples", "fixtures") for p in parts):
+        return True
+    base = os.path.basename(norm)
+    return base in _MX315_OWNER_FILES or base.startswith("test_")
+
+
+def _scan_checkpoint_discipline(tree, path, findings):
+    if _mx315_exempt(path):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            getattr(f, "id", None)
+        if name not in _MX315_WRITE_NAMES:
+            continue
+        findings.append(Finding(
+            get_rule("MX315"),
+            f"direct `{name}` outside utils/checkpoint.py / "
+            "resilience/ckpt_async.py — the checkpoint plane owns "
+            "durability ordering (tmp staging, CRC commit, retention GC, "
+            "writer flush barriers) and the `checkpoint` badput pricing; "
+            "route through ckpt_async.save_now or "
+            "AsyncCheckpointWriter.submit",
+            path=path, line=node.lineno, col=node.col_offset))
+
+
 # calls whose presence inside a retry loop counts as bounding it: anything
 # sleep/backoff/wait-shaped (time.sleep, policy backoff, cv.wait_for, ...)
 _BOUNDING_CALL_PARTS = ("sleep", "backoff", "wait", "delay", "retry_call",
@@ -1389,6 +1442,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     _scan_fleet_actuation(tree, path, scan.findings)
     _scan_kernel_discipline(tree, path, scan.findings)
     _scan_profiler_discipline(tree, path, scan.findings)
+    _scan_checkpoint_discipline(tree, path, scan.findings)
     _scan_placement_discipline(tree, path, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)

@@ -416,6 +416,12 @@ class _GroupServer:
         self._contrib: dict = {}  # key -> {worker ids in the open round}
         self._applied: dict = {}  # (key, worker) -> (seq, round applied in)
         self.duplicate_count = 0
+        # T1 checkpoint replicas (ISSUE 17): origin rank -> (step, payload),
+        # newest-wins by checkpoint step — a resend or late replica of an
+        # older step is dropped, which makes the op naturally idempotent
+        self._replicas: dict = {}
+        self.replica_count = 0
+        self.replica_duplicate_count = 0
         self._barrier_count = 0
         self._barrier_round = 0
         self._left: set = set()  # deregistered workers (idempotence)
@@ -606,6 +612,26 @@ class _GroupServer:
         telemetry.emit_server_span("pull", trace, t0, key=key)
         return value
 
+    def push_replica(self, origin, step, payload):
+        """T1 checkpoint tier (ISSUE 17): hold ``origin``'s newest
+        snapshot so a peer can restore from RAM. Newest-wins by step
+        (duplicate/stale replicas counted, not applied) — the same
+        exactly-once-per-(origin, step) contract as deduped pushes.
+        Returns True when the replica was kept."""
+        with self.lock:
+            prev = self._replicas.get(int(origin))
+            if prev is not None and int(step) <= prev[0]:
+                self.replica_duplicate_count += 1
+                return False
+            self._replicas[int(origin)] = (int(step), payload)
+            self.replica_count += 1
+            return True
+
+    def pull_replica(self, origin):
+        """Newest replicated ``(step, payload)`` for ``origin`` or None."""
+        with self.lock:
+            return self._replicas.get(int(origin))
+
     def barrier(self):
         """Membership-epoch-tagged barrier round: released when every
         CURRENT member arrived (a deregistration mid-round re-evaluates
@@ -654,6 +680,15 @@ class _GroupWorkerKVStore(KVStore):
         spec = super().set_gradient_compression(compression)
         self._codec = None  # rebuilt (fresh residuals) on next push
         return spec
+
+    def push_replica(self, origin, step, payload):
+        """Replicate a checkpoint snapshot to the group server's T1 slot
+        (in-process: the payload is held by reference; the dist_async
+        wire path pickles the same structure)."""
+        return self._server.push_replica(origin, step, payload)
+
+    def pull_replica(self, origin):
+        return self._server.pull_replica(origin)
 
     def compression_stats(self) -> dict:
         """Worker-side wire accounting for the compressed push path."""

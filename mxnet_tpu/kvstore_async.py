@@ -216,6 +216,12 @@ class _AsyncServer:
         # client serializes requests per rank, so one slot suffices)
         self._applied: dict = {}
         self.duplicate_count = 0
+        # T1 checkpoint replicas (ISSUE 17): origin rank -> (step, blob).
+        # Newest-wins by checkpoint step; requests ride the same
+        # (rank, seq) replay cache as pushes, so a retried replica is
+        # answered from cache instead of re-applied
+        self._replicas: dict = {}
+        self.replica_count = 0
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -477,6 +483,30 @@ class _AsyncServer:
                     return False
                 values = {k: self.store[k].copy() for k in keys}
             _send_msg(conn, ("ok", values))
+        elif op == "replica":
+            # T1 checkpoint tier (ISSUE 17): hold ``origin``'s newest
+            # snapshot blob so a peer can restore from RAM after a resize.
+            # Newest-wins by checkpoint step (a late replica of an older
+            # step is dropped, not applied), deduped like pushes.
+            _, origin, step, blob = msg[:4]
+            ident = tuple(msg[4:6]) if len(msg) >= 6 else None
+            if self._replay(conn, ident):
+                return False
+            with self.lock:
+                prev = self._replicas.get(origin)
+                if prev is None or int(step) > prev[0]:
+                    self._replicas[origin] = (int(step), blob)
+                    self.replica_count += 1
+                    reply = ("ok", True)
+                else:
+                    reply = ("ok", False)  # stale replica: dropped
+            self._record(ident, reply)
+            _send_msg(conn, reply)
+        elif op == "replica_pull":
+            _, origin = msg
+            with self.lock:
+                ent = self._replicas.get(origin)
+            _send_msg(conn, ("ok", ent))
         elif op == "stats":
             # the full server-health head: workers mirror these as hub
             # gauges so server state shows up in worker-side traces
@@ -486,6 +516,7 @@ class _AsyncServer:
                     "wire_bytes_received": self.wire_bytes_received,
                     "raw_bytes_received": self.raw_bytes_received,
                     "duplicate_count": self.duplicate_count,
+                    "replica_count": self.replica_count,
                     "num_workers": self.num_workers,
                     "keys": len(self.store),
                     "barrier_round": self._barrier_round,
@@ -794,6 +825,24 @@ class AsyncKVStore(KVStore):
                 outs = [outs]
             for o in outs:
                 NDArray(value).copyto(o)
+
+    def push_replica(self, origin, step, payload):
+        """T1 checkpoint tier: ship ``origin``'s step-``step`` snapshot
+        payload (any picklable state tree) to the server's replica slot.
+        (rank, seq)-deduped like pushes; newest step wins server-side.
+        Returns True when the server kept it (False = stale)."""
+        blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        return self._call("replica", int(origin), int(step), blob,
+                          mutating=True)
+
+    def pull_replica(self, origin):
+        """Fetch the newest replicated snapshot for ``origin`` as
+        ``(step, payload)``, or None when no replica was ever pushed."""
+        ent = self._call("replica_pull", int(origin))
+        if ent is None:
+            return None
+        step, blob = ent
+        return int(step), pickle.loads(blob)
 
     def set_gradient_compression(self, compression):
         """Arm quantized+bucketed batch pushes (reference:

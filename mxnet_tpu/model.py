@@ -67,19 +67,38 @@ BASE_ESTIMATOR = object
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """Write `prefix-symbol.json` + `prefix-%04d.params` (reference:
-    model.py:392-421)."""
-    symbol.save(f"{prefix}-symbol.json")
+    model.py:392-421).
+
+    Both files go through the sharded tier's atomic writer (ISSUE 17:
+    tmp + ``os.replace`` + a ``.crc32`` sidecar), so a kill mid-save can
+    no longer tear the params file — the old file stays whole until the
+    new one is fully on disk."""
+    from .utils import checkpoint as ckpt_mod
+
+    ckpt_mod.atomic_write(f"{prefix}-symbol.json",
+                          lambda tmp: symbol.save(tmp))
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+    ckpt_mod.atomic_write(f"{prefix}-{epoch:04d}.params",
+                          lambda tmp: nd.save(tmp, save_dict))
     logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix, epoch)
 
 
 def load_checkpoint(prefix, epoch):
     """Load what save_checkpoint wrote; returns (symbol, arg_params, aux_params)
-    (reference: model.py:452-461)."""
+    (reference: model.py:452-461). Files written by the atomic path carry
+    a ``.crc32`` sidecar that is verified here — a torn or corrupt params
+    file fails loud instead of loading garbage; pre-sidecar legacy files
+    load as before."""
+    from .utils import checkpoint as ckpt_mod
+
+    params_path = f"{prefix}-{epoch:04d}.params"
+    if ckpt_mod.check_sidecar(params_path) is False:
+        raise MXNetError(
+            f"checkpoint {params_path} fails its CRC sidecar "
+            "(torn or corrupt write) — refusing to load")
     symbol = sym_mod.load(f"{prefix}-symbol.json")
-    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    save_dict = nd.load(params_path)
     arg_params, aux_params = {}, {}
     for k, v in save_dict.items():
         tp, name = k.split(":", 1)
@@ -1011,7 +1030,8 @@ class FeedForward(BASE_ESTIMATOR):
             sharded_checkpoint_dir=None, guards=None, pad_policy=None,
             compression=None, overlap=None, comm_kernels=None,
             telemetry=None, elastic=None, controller=None, health=None,
-            profile=None, shard_audit=None):
+            profile=None, shard_audit=None,
+            checkpoint_every_n_steps=None):
         """Train (reference: model.py:669 fit -> _train_multi_device:171).
 
         ``work_load_list`` is accepted for parity and ignored: XLA SPMD
@@ -1154,7 +1174,24 @@ class FeedForward(BASE_ESTIMATOR):
         wall time is priced as a ``profile`` badput bucket; the report
         lands on ``self.profile_report`` and as a ``profile`` summary
         event + ``profile_*`` gauges (doc/developer-guide/telemetry.md,
-        "Device profiling")."""
+        "Device profiling").
+
+        ``checkpoint_every_n_steps``: step-granular async checkpoint
+        cadence (ISSUE 17) — None (default; env gate
+        ``MXNET_TPU_CKPT_STEPS``) or an int N. When armed (requires
+        ``sharded_checkpoint_dir``), every N optimizer steps the loop
+        takes ONE blocking device->host snapshot and returns to training
+        while the ``mx-ckpt-writer`` thread persists it to the atomic
+        CRC-manifest format (T2), prunes old steps
+        (``MXNET_TPU_CKPT_KEEP``), and the snapshot is replicated to a
+        neighbor rank's RAM over the kvstore ``replica`` op (T1) so an
+        elastic resize restores without a disk read. Step metadata
+        (data-iterator position, RNG state, loss scale, ``num_update``)
+        makes resume mid-epoch and bitwise-equal to a checkpoint-replay
+        reference; writer failures surface as ``checkpoint`` flight
+        incidents, never as training exceptions
+        (doc/developer-guide/resilience.md, "Async + multi-tier
+        checkpointing")."""
         del work_load_list
         guard_cfg = guards_mod.GuardConfig.resolve(guards)
         health_cfg = telemetry_mod.HealthConfig.resolve(health)
@@ -1166,9 +1203,13 @@ class FeedForward(BASE_ESTIMATOR):
         comm_spec = comm_mod.CompressionSpec.resolve(compression)
         overlap_cfg = comm_mod.OverlapConfig.resolve(overlap)
         kern_cfg = comm_mod.CommKernelConfig.resolve(comm_kernels)
+        from .resilience import ckpt_async as ckpt_plane_mod
+
+        ckpt_every = ckpt_plane_mod.resolve_every(checkpoint_every_n_steps)
         resume_opt_leaves, resume_num_update = None, 0
         resume_scale = None
         resume_comm_state, resume_comm_layout = None, None
+        resume_batches_done = 0
         if sharded_checkpoint_dir is not None:
             from .utils import checkpoint as ckpt_mod
 
@@ -1188,9 +1229,18 @@ class FeedForward(BASE_ESTIMATOR):
                 self.begin_epoch = int(meta.get("epoch", last))
                 resume_num_update = int(meta.get("num_update", 0))
                 resume_scale = meta.get("loss_scale")
+                # step-granular resume (ISSUE 17): a mid-epoch snapshot
+                # records how many batches the interrupted epoch already
+                # trained and the RNG key words at the boundary — the
+                # resumed loop fast-forwards the iterator and draws the
+                # same per-step subkeys the original run would have
+                resume_batches_done = int(meta.get("batches_done", 0))
+                if meta.get("rng_state") is not None:
+                    random_mod.set_state(meta["rng_state"])
                 (logger or logging).info(
-                    "resumed sharded checkpoint step %d (epoch %d)",
-                    last, self.begin_epoch)
+                    "resumed sharded checkpoint step %d (epoch %d, "
+                    "batches_done %d)", last, self.begin_epoch,
+                    resume_batches_done)
         if logger is None:
             logger = logging
         train_data = _init_iter(X, y, batch_size, shuffle=True)
@@ -1468,7 +1518,10 @@ class FeedForward(BASE_ESTIMATOR):
                 fp32_wire_bytes=comm_mod.fp32_allreduce_wire_bytes(
                     comm_mod.flat_size(params), ndev_now)
                 if mesh is not None else 0.0,
-                health=hmon, logger=logger)
+                health=hmon,
+                ckpt_every=(ckpt_every if sharded_checkpoint_dir is not None
+                            else None),
+                logger=logger)
             logger.info("controller: %s (%r)", fleet_ctl.state,
                         fleet_ctl.cfg)
 
@@ -1644,6 +1697,17 @@ class FeedForward(BASE_ESTIMATOR):
             return {"loss_scale": float(np.asarray(_host_local(
                 gstate["scale"])))}
 
+        def _resume_meta(batches_done):
+            """Step-granular resume meta (armed runs only): the data
+            iterator's position in the epoch plus the generator's key
+            words at this step boundary — together with ``num_update``
+            they make a resumed run bitwise-equal to one that never
+            stopped."""
+            if ckpt_every is None:
+                return {}
+            return {"batches_done": int(batches_done),
+                    "rng_state": random_mod.get_state()}
+
         def _comm_ckpt():
             """(comm_state, meta) for save_sharded: the live EF residual
             ledger(s) plus the layout key resume validates against."""
@@ -1667,21 +1731,29 @@ class FeedForward(BASE_ESTIMATOR):
                 pulled = kv.flush_stale(param_names)
                 params = {k: jnp.asarray(pulled[k]) for k in param_names}
             if sharded_checkpoint_dir is not None:
-                from .utils import checkpoint as ckpt_mod
-
                 # flush points sit at step boundaries, where the params
                 # pytree always holds weights (the async path re-pulls them
-                # right after every step), so the live state is consistent
+                # right after every step), so the live state is consistent.
+                # Armed step-granular runs flush under the num_update step
+                # id with the full resume meta (batches_done + RNG), so
+                # the relaunch resumes mid-epoch instead of redoing it;
+                # any queued async snapshot drains first so the flush is
+                # the newest step on disk.
+                if ckpt_writer is not None:
+                    ckpt_writer.flush()
                 comm_state, comm_meta = _comm_ckpt()
-                ckpt_mod.save_sharded(
-                    sharded_checkpoint_dir, epoch, params, aux=aux,
+                step_id = num_update if ckpt_every is not None else epoch
+                ckpt_plane_mod.save_now(
+                    sharded_checkpoint_dir, step_id, params, aux=aux,
                     symbol=self.symbol, opt_state=opt_state,
                     comm_state=comm_state,
                     extra_meta={"epoch": epoch, "num_update": num_update,
-                                "preempted": True, **_guard_meta(),
-                                **comm_meta})
+                                "preempted": True, **_resume_meta(nbatch),
+                                **_guard_meta(), **comm_meta},
+                    keep=ckpt_writer.keep_last_k
+                    if ckpt_writer is not None else None)
                 logger.info("preemption: flushed checkpoint step %d "
-                            "(epoch %d, %d updates)", epoch, epoch,
+                            "(epoch %d, %d updates)", step_id, epoch,
                             num_update)
             # black box alongside the checkpoint: the last K steps +
             # incidents that led into the preemption
@@ -1717,7 +1789,7 @@ class FeedForward(BASE_ESTIMATOR):
             (kind="resize") and in goodput as ``resize`` badput."""
             nonlocal mesh, params, opt_state, aux, gstate, cstate, \
                 resid_layout_key, overlap_plan, num_update, _place_batch, \
-                hstate
+                hstate, skip_batches
             from .utils import checkpoint as ckpt_mod
 
             t0 = time.time()
@@ -1736,11 +1808,47 @@ class FeedForward(BASE_ESTIMATOR):
                 elastic_co.commit(ev, logger=logger)
                 self.ctx = [elastic_base_ctx[r] for r in ev.ranks]
                 mesh = self._make_mesh(dist=False)
-                # re-shard: params/aux land replicated on the NEW mesh
-                # straight from the newest CRC-valid checkpoint; optimizer
-                # leaves re-thread through this optimizer's treedef
-                loaded, laux, _, meta, opt_leaves, comm_saved = \
-                    ckpt_mod.load_resharded(sharded_checkpoint_dir, mesh)
+                # re-shard: T1 first (ISSUE 17) — the freshest snapshot
+                # whose holder survived restores from RAM with no disk
+                # read; disk (T2, the newest CRC-valid checkpoint) is the
+                # fallback when the peer died too. A departed rank's
+                # replicas are forgotten first so a rejoin cannot
+                # resurrect stale state.
+                if ckpt_writer is not None:
+                    # queued snapshots become the disk fallback's newest
+                    # state; drain before deciding which tier restores
+                    ckpt_writer.flush()
+                restored = None
+                if ckpt_replicas is not None:
+                    for r in range(ckpt_replicas.world_size):
+                        if r not in ev.ranks:
+                            ckpt_replicas.drop_rank(r)
+                    restored = ckpt_replicas.restore(alive=ev.ranks)
+                if restored is not None:
+                    t_r = time.time()
+                    repl = NamedSharding(mesh, P())
+                    loaded = {k: jax.device_put(np.asarray(v), repl)  # mxlint: disable=MX805 - peer-tier restore replicates onto the new mesh, same contract as load_resharded
+                              for k, v in
+                              restored.state.get("params", {}).items()}
+                    laux = {k: jax.device_put(np.asarray(v), repl)  # mxlint: disable=MX805 - peer-tier restore replicates onto the new mesh, same contract as load_resharded
+                            for k, v in
+                            restored.state.get("aux", {}).items()}
+                    meta = dict(restored.meta)
+                    opt_leaves = restored.state.get("opt")
+                    comm_saved = restored.state.get("comm")
+                    jax.block_until_ready(
+                        list(loaded.values()) + list(laux.values()))
+                    telemetry_mod.counter("ckpt_peer_restores_total")
+                    telemetry_mod.emit(
+                        "checkpoint", step=restored.step,
+                        seconds=time.time() - t_r, tier="t1")
+                    logger.info(
+                        "elastic: restored step %d from the in-memory "
+                        "peer tier (no disk read)", restored.step)
+                else:
+                    loaded, laux, _, meta, opt_leaves, comm_saved = \
+                        ckpt_mod.load_resharded(sharded_checkpoint_dir,
+                                                mesh)
                 params = {k: loaded[k] for k in param_names}
                 aux = {k: laux[k] for k in aux_names}
                 opt_state = optimizer.init_state_tree(params)
@@ -1752,6 +1860,12 @@ class FeedForward(BASE_ESTIMATOR):
                             [jnp.asarray(np.asarray(leaf))
                              for leaf in opt_leaves])
                 num_update = int(meta.get("num_update", num_update))
+                # step-granular resume (ISSUE 17): a mid-epoch snapshot
+                # fast-forwards the redone epoch past the batches it
+                # already trained, with the RNG rewound to the boundary
+                skip_batches = int(meta.get("batches_done", 0))
+                if meta.get("rng_state") is not None:
+                    random_mod.set_state(meta["rng_state"])
                 if guard_cfg is not None:
                     gstate = guards_mod.init_guard_state(
                         guard_cfg, scale=meta.get("loss_scale"))
@@ -1864,6 +1978,57 @@ class FeedForward(BASE_ESTIMATOR):
                 train_steps.clear()
                 fleet_ctl.actuation_failed("retier", e, logger=logger)
 
+        # -- async multi-tier checkpoint plane (ISSUE 17) ------------------
+        ckpt_writer = None
+        ckpt_replicas = None
+        skip_batches = resume_batches_done
+        ckpt_last_update = -1
+        if sharded_checkpoint_dir is not None and ckpt_every is not None:
+            ckpt_writer = ckpt_plane_mod.AsyncCheckpointWriter(
+                sharded_checkpoint_dir, logger=logger)
+            _ckpt_world = elastic_co.world_size if elastic_co is not None \
+                else (int(mesh.shape["dp"]) if mesh is not None else 1)
+            ckpt_replicas = ckpt_plane_mod.ReplicaStore(_ckpt_world)
+            # diagnostic/test handle (mirrors self.health_monitor)
+            self.ckpt_replicas = ckpt_replicas
+            logger.info(
+                "ckpt_async: armed every %d step(s) -> %s (keep %d, "
+                "queue %d, world %d)", ckpt_every, sharded_checkpoint_dir,
+                ckpt_writer.keep_last_k, ckpt_writer.queue_depth,
+                _ckpt_world)
+
+        def _ckpt_tick():
+            """Cadence hit at a step boundary: ONE blocking device->host
+            copy, then training continues — the writer thread owns the
+            durable (T2) write and the peer tier (T1) takes the same
+            snapshot. Replication of a rank's shard is suppressed when
+            the ``ckpt.replica`` chaos site fires (the mid-replication
+            kill of the acceptance test)."""
+            comm_state, comm_meta = _comm_ckpt()
+            snap = ckpt_plane_mod.capture_snapshot(
+                num_update, params, aux=aux, opt_state=opt_state,
+                comm_state=comm_state,
+                meta={"epoch": epoch, "num_update": num_update,
+                      **_resume_meta(nbatch), **_guard_meta(), **comm_meta},
+                symbol=self.symbol)
+            ckpt_writer.submit(snap)
+            ckpt_writer.note_step(num_update)
+            alive = elastic_co.alive if elastic_co is not None \
+                else range(ckpt_replicas.world_size)
+            for r in alive:
+                if not chaos_mod.fires("ckpt.replica"):
+                    ckpt_replicas.replicate(r, snap)
+            if kv is not None and hasattr(kv, "push_replica"):
+                # dist paths mirror the snapshot over the kvstore wire
+                # (the ``replica`` op) so a peer PROCESS can restore it
+                try:
+                    kv.push_replica(kv.rank, num_update,
+                                    {"state": snap.state,
+                                     "meta": snap.meta})
+                except Exception as e:  # T1 is best-effort, T2 stands
+                    logger.warning("ckpt_async: wire replication "
+                                   "failed: %s", e)
+
         if elastic_co is not None:
             from .utils import checkpoint as ckpt_mod
 
@@ -1871,11 +2036,13 @@ class FeedForward(BASE_ESTIMATOR):
                 # a first-epoch membership change needs a reshard source:
                 # persist the starting state as the floor checkpoint
                 comm_state, comm_meta = _comm_ckpt()
-                ckpt_mod.save_sharded(
-                    sharded_checkpoint_dir, epoch, params, aux=aux,
+                floor_id = num_update if ckpt_every is not None else epoch
+                ckpt_plane_mod.save_now(
+                    sharded_checkpoint_dir, floor_id, params, aux=aux,
                     symbol=self.symbol, opt_state=opt_state,
                     comm_state=comm_state,
                     extra_meta={"epoch": epoch, "num_update": num_update,
+                                **_resume_meta(resume_batches_done),
                                 **_guard_meta(), **comm_meta})
 
         try:
@@ -1919,6 +2086,16 @@ class FeedForward(BASE_ESTIMATOR):
             feed_src = _timed_feed(feed, tl) if tl is not None else feed
             try:
                 for batch, batch_arrays in feed_src:
+                    if skip_batches > 0:
+                        # step-granular resume (ISSUE 17): fast-forward a
+                        # resumed/redone epoch past batches it already
+                        # trained — consume the feed without dispatching,
+                        # without drawing RNG keys and without advancing
+                        # num_update, so the first live batch sees exactly
+                        # the state the checkpointed run saw
+                        skip_batches -= 1
+                        nbatch += 1
+                        continue
                     if fleet_ctl is not None:
                         # policy tick (synchronous mode), then any staged
                         # actuation that must run on the training thread
@@ -2168,6 +2345,27 @@ class FeedForward(BASE_ESTIMATOR):
                         eval_metric.update(labels_h,
                                            [NDArray(o) for o in outs_h])
                     nbatch += 1
+                    if ckpt_writer is not None and \
+                            num_update % ckpt_every == 0 and \
+                            num_update != ckpt_last_update:
+                        # cadence hit (ISSUE 17): one blocking host copy,
+                        # then the writer thread owns durability — the
+                        # loop is back on the next batch immediately.
+                        # (guard-skipped steps leave num_update in place:
+                        # the dedup keeps a skipped batch from re-saving
+                        # the same update)
+                        ckpt_last_update = num_update
+                        _ckpt_tick()
+                    if ckpt_writer is not None and \
+                            fleet_ctl is not None:
+                        ckpt_act = fleet_ctl.take_ckpt_cadence()
+                        if ckpt_act is not None:
+                            # controller-staged cadence change: host-side
+                            # counter only, nothing recompiles
+                            ckpt_every = max(1, int(ckpt_act["every"]))
+                            fleet_ctl.ckpt_cadence_applied(ckpt_act)
+                            logger.info("controller: checkpoint cadence "
+                                        "-> every %d step(s)", ckpt_every)
                     if batch_end_callback is not None:
                         p = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                           eval_metric=eval_metric)
@@ -2292,16 +2490,28 @@ class FeedForward(BASE_ESTIMATOR):
                         self.guard_stats["loss_scale"])
 
             if sharded_checkpoint_dir is not None:
-                from .utils import checkpoint as ckpt_mod
-
+                if ckpt_writer is not None:
+                    # drain first: a queued cadence snapshot may share
+                    # this num_update's step id, and two writers must
+                    # never race one .tmp.<step> dir
+                    ckpt_writer.flush()
                 comm_state, comm_meta = _comm_ckpt()
-                ckpt_mod.save_sharded(
-                    sharded_checkpoint_dir, epoch + 1, params, aux=aux,
+                # armed runs keep ONE step-id namespace (num_update) for
+                # cadence and epoch-end saves; unarmed runs keep the
+                # legacy epoch-granular ids. batches_done=0: the resumed
+                # run starts the NEXT epoch from its top.
+                step_id = num_update if ckpt_every is not None \
+                    else epoch + 1
+                ckpt_plane_mod.save_now(
+                    sharded_checkpoint_dir, step_id, params, aux=aux,
                     symbol=self.symbol, opt_state=opt_state,
                     comm_state=comm_state,
                     extra_meta={"epoch": epoch + 1,
-                                "num_update": num_update, **_guard_meta(),
-                                **comm_meta})
+                                "num_update": num_update,
+                                **_resume_meta(0), **_guard_meta(),
+                                **comm_meta},
+                    keep=ckpt_writer.keep_last_k
+                    if ckpt_writer is not None else None)
 
             if mfu_acct is not None and nbatch:
                 spans_e = tl.spans[epoch_span_base:] if tl is not None else []
@@ -2350,6 +2560,10 @@ class FeedForward(BASE_ESTIMATOR):
             profile_badput = 0.0
             epoch += 1
         finally:
+            if ckpt_writer is not None:
+                # drain queued snapshots so the last cadence hit is
+                # durable, then stop mx-ckpt-writer
+                ckpt_writer.close()
             if watchdog is not None:
                 watchdog.stop()
             if preempt_handler is not None:

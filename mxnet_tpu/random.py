@@ -17,10 +17,14 @@ import threading
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .ndarray import NDArray, _out_wrap, current_context
 
-__all__ = ["seed", "uniform", "normal", "randint", "next_key"]
+__all__ = [
+    "seed", "uniform", "normal", "randint", "next_key",
+    "get_state", "set_state",
+]
 
 _state = threading.local()
 
@@ -34,6 +38,23 @@ def _key():
 def seed(seed_state: int):
     """Seed the global generator (reference: mx.random.seed / MXRandomSeed)."""
     _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def get_state() -> list:
+    """Serializable snapshot of the generator (a list of raw key words).
+
+    Used by step-granular checkpoints: persisting the key alongside
+    ``num_update`` makes a resumed run draw the same per-step subkeys the
+    original run would have drawn, which is a precondition for bitwise
+    resume.
+    """
+    return [int(v) for v in np.asarray(jax.random.key_data(_key())).ravel()]
+
+
+def set_state(words) -> None:
+    """Restore a generator snapshot produced by :func:`get_state`."""
+    data = np.asarray(list(words), dtype=np.uint32)
+    _state.key = jnp.asarray(data)
 
 
 def next_key():

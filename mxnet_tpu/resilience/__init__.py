@@ -13,6 +13,10 @@ Failure model (what the pieces cover):
                                        checkpoint flush in model.fit
   torn / corrupt checkpoints        -> utils.checkpoint manifest (CRC) +
                                        latest_step skipping invalid steps
+  work lost to coarse checkpoints   -> ckpt_async: async T0 snapshots, an
+                                       in-memory T1 peer-replica tier and
+                                       a step-granular durable T2 tier
+                                       (bitwise mid-epoch resume)
   worker churn (die / rejoin)       -> elastic.ElasticCoordinator: resize
                                        the world mid-run without a process
                                        restart (fit(elastic=...); kvstore
@@ -32,6 +36,9 @@ Failure model (what the pieces cover):
 from .chaos import (Chaos, ChaosConfig, TransientError, TransientStepError,
                     chaos_scope)
 from . import chaos
+from . import ckpt_async
+from .ckpt_async import (AsyncCheckpointWriter, ReplicaStore, Snapshot,
+                         capture_snapshot)
 from . import controller
 from . import elastic
 from .controller import FleetController, FleetControllerConfig
@@ -44,6 +51,8 @@ from .retry import CircuitBreaker, CircuitOpenError, RetryingKVStore, \
 
 __all__ = ["chaos", "Chaos", "ChaosConfig", "chaos_scope",
            "TransientError", "TransientStepError",
+           "ckpt_async", "AsyncCheckpointWriter", "ReplicaStore",
+           "Snapshot", "capture_snapshot",
            "controller", "FleetController", "FleetControllerConfig",
            "elastic", "ElasticCoordinator", "MembershipChanged",
            "MembershipTimeout", "ResizeEvent",

@@ -1,0 +1,355 @@
+"""Asynchronous multi-tier checkpoint plane (ISSUE 17).
+
+Every recovery path used to funnel through one synchronous, epoch-granular
+disk save: ``save_sharded`` blocked the step loop for the full serialize
+wall, resize forced a disk round-trip, and resume redid the whole
+interrupted epoch. This module splits checkpointing into three tiers:
+
+  T0  non-blocking snapshot: one blocking device->host copy at a step
+      boundary (``capture_snapshot``), then the step loop continues while
+      a bounded-queue background writer thread (``mx-ckpt-writer``,
+      lockwatch-registered) drains snapshots to the CRC-manifest atomic
+      on-disk format. Backpressure drops the OLDEST pending snapshot
+      (newest state wins — a checkpoint plane is a freshness cache, not a
+      log), and writer failures surface as ``checkpoint`` flight
+      incidents, never as exceptions out of the step loop.
+  T1  in-memory peer replication: each rank's param/opt/EF shard is
+      mirrored to a neighbor over the kvstore wire (the ``replica`` op,
+      (rank, seq)-deduped like pushes). Elastic resize and controller
+      evict/backfill restore from RAM; disk is only touched when the
+      holder died too. ``ReplicaStore`` is the in-process model of that
+      tier (the virtual-world kvstore carries the same blobs).
+  T2  the durable disk tier — the existing tmp+rename+CRC format, now
+      with step-granular metadata (data-iterator position, RNG state,
+      loss scale, ``num_update``) so resume is mid-epoch and bitwise
+      equal to a checkpoint-replay reference.
+
+TensorFlow (arXiv:1605.08695) treats checkpointing as a first-class
+system concern; the reference's two-level parameter server
+(arXiv:1512.01274) kept state recoverable from peers, not only disk —
+this plane is both ideas folded into the TPU-native stack.
+
+Snapshot wall (the only stall the step loop sees) and the background
+write both run under ``telemetry.phase("checkpoint_save")`` so they price
+into the existing ``checkpoint`` badput bucket; the plane publishes
+``ckpt_queue_depth`` / ``ckpt_snapshot_age_steps`` / ``ckpt_bytes_written``
+gauges and the ``checkpoint`` event kind carries a ``tier`` field.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+import jax
+
+from ..analysis.lockwatch import named_condition, named_lock
+from ..utils import checkpoint as ckpt_mod
+
+__all__ = ["Snapshot", "capture_snapshot", "AsyncCheckpointWriter",
+           "ReplicaStore", "save_now", "resolve_every", "resolve_keep"]
+
+_WRITER_THREAD = "mx-ckpt-writer"
+
+
+def resolve_every(arg=None):
+    """Checkpoint cadence in optimizer steps: explicit ``fit`` argument
+    wins, else ``MXNET_TPU_CKPT_STEPS``, else None (epoch-granular only,
+    the pre-PR-17 behavior)."""
+    if arg is not None:
+        return max(1, int(arg))
+    env = os.environ.get("MXNET_TPU_CKPT_STEPS", "").strip()
+    if env:
+        return max(1, int(env))
+    return None
+
+
+def resolve_keep(arg=None):
+    """Retention depth for the disk tier: explicit argument, else
+    ``MXNET_TPU_CKPT_KEEP``, else 3. ``0`` disables pruning."""
+    if arg is not None:
+        return int(arg)
+    return int(os.environ.get("MXNET_TPU_CKPT_KEEP", "3"))
+
+
+def resolve_queue_depth(arg=None):
+    """Bounded writer queue depth: explicit argument, else
+    ``MXNET_TPU_CKPT_QUEUE``, else 2 (one draining + one pending)."""
+    if arg is not None:
+        return max(1, int(arg))
+    return max(1, int(os.environ.get("MXNET_TPU_CKPT_QUEUE", "2")))
+
+
+class Snapshot:
+    """A host-side copy of one step's full training state.
+
+    ``state`` mirrors the on-disk layout: ``{"params", "aux"?, "opt"?
+    (flat leaves), "comm"?}``, all host numpy. ``meta`` is the JSON
+    metadata dict (step/epoch/batches_done/rng_state/loss_scale/
+    num_update/...). The same object feeds T2 (the writer serializes it)
+    and T1 (the replica tier ships it to a peer)."""
+
+    __slots__ = ("step", "state", "meta", "symbol")
+
+    def __init__(self, step, state, meta, symbol=None):
+        self.step = int(step)
+        self.state = state
+        self.meta = dict(meta or {})
+        self.symbol = symbol
+
+
+def capture_snapshot(step, params, aux=None, opt_state=None,
+                     comm_state=None, meta=None, symbol=None):
+    """The T0 stall: one blocking device->host transfer of the full
+    training state at a step boundary, returned as a :class:`Snapshot`.
+
+    This is the ONLY part of an async checkpoint the step loop waits for;
+    it runs under the ``checkpoint_save`` phase so the stall prices into
+    the checkpoint badput bucket. Everything stays host-side (a plain
+    ``jax.device_get``) so the jitted step program and its cache keys are
+    untouched — the zero-recompile invariant holds with checkpointing
+    armed."""
+    from .. import telemetry
+
+    with telemetry.phase("checkpoint_save"):
+        state = {"params": dict(params)}
+        if aux:
+            state["aux"] = dict(aux)
+        if opt_state is not None:
+            state["opt"] = list(jax.tree_util.tree_leaves(opt_state))
+        if comm_state is not None:
+            state["comm"] = dict(comm_state)
+        state = jax.device_get(state)
+    return Snapshot(step, state, meta, symbol=symbol)
+
+
+class AsyncCheckpointWriter:
+    """Bounded-queue background writer: drains :class:`Snapshot`\\ s to the
+    durable T2 tier without stalling the step loop.
+
+    - ``submit`` never blocks: when the queue is full the OLDEST pending
+      snapshot is dropped (``ckpt_snapshots_dropped_total``) — durability
+      lag is bounded by queue depth x cadence, and the freshest state
+      always wins.
+    - Write failures are counted (``ckpt_write_failures_total``), surfaced
+      as ``checkpoint`` flight incidents with an ``error`` field, and
+      trigger a flight auto-dump; they never propagate into training.
+    - After each durable write the retention pruner runs
+      (``keep_last_k``, env ``MXNET_TPU_CKPT_KEEP``), so step-granular
+      cadence cannot fill the disk.
+    """
+
+    def __init__(self, directory, queue_depth=None, keep_last_k=None,
+                 logger=None):
+        self.directory = os.path.abspath(os.fspath(directory))
+        self.queue_depth = resolve_queue_depth(queue_depth)
+        self.keep_last_k = resolve_keep(keep_last_k)
+        self.logger = logger or logging.getLogger(__name__)
+        self.lock = named_lock("ckpt_async.AsyncCheckpointWriter")
+        self.cv = named_condition("ckpt_async.AsyncCheckpointWriter.cv",
+                                  self.lock)
+        self._pending: deque = deque()
+        self._inflight = None
+        self._closed = False
+        self._last_durable_step = None
+        self.submitted = 0
+        self.written = 0
+        self.dropped = 0
+        self.failures = 0
+        self._thread = threading.Thread(
+            target=self._run, name=_WRITER_THREAD, daemon=True)
+        self._thread.start()
+
+    # -- producer side (step loop) ----------------------------------------
+
+    def submit(self, snap: Snapshot):
+        """Queue a snapshot for background write. Never blocks: a full
+        queue drops the oldest pending snapshot."""
+        from .. import telemetry
+
+        with self.lock:
+            if self._closed:
+                return False
+            while len(self._pending) >= self.queue_depth:
+                victim = self._pending.popleft()
+                self.dropped += 1
+                telemetry.counter("ckpt_snapshots_dropped_total")
+                self.logger.warning(
+                    "ckpt_async: queue full, dropped pending snapshot for "
+                    "step %d (depth %d)", victim.step, self.queue_depth)
+            self._pending.append(snap)
+            self.submitted += 1
+            depth = len(self._pending)
+            self.cv.notify_all()
+        telemetry.gauge("ckpt_queue_depth", float(depth))
+        return True
+
+    def note_step(self, step):
+        """Publish staleness: how many optimizer steps the newest durable
+        checkpoint trails the live run."""
+        from .. import telemetry
+
+        with self.lock:
+            last = self._last_durable_step
+        if last is not None:
+            telemetry.gauge("ckpt_snapshot_age_steps",
+                            float(max(0, int(step) - last)))
+
+    def flush(self, timeout=60.0):
+        """Block until every queued snapshot is durable (or timeout).
+        Returns True when the queue fully drained."""
+        with self.lock:
+            deadline = time.monotonic() + timeout
+            while self._pending or self._inflight is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self.cv.wait(timeout=remaining)
+        return True
+
+    def close(self, timeout=60.0):
+        """Drain pending snapshots, then stop the writer thread."""
+        self.flush(timeout=timeout)
+        with self.lock:
+            self._closed = True
+            self.cv.notify_all()
+        self._thread.join(timeout=timeout)
+
+    @property
+    def last_durable_step(self):
+        with self.lock:
+            return self._last_durable_step
+
+    # -- writer thread -----------------------------------------------------
+
+    def _run(self):
+        from .. import telemetry
+
+        while True:
+            with self.lock:
+                while not self._pending and not self._closed:
+                    self.cv.wait()
+                if not self._pending and self._closed:
+                    return
+                snap = self._pending.popleft()
+                self._inflight = snap
+                depth = len(self._pending)
+            telemetry.gauge("ckpt_queue_depth", float(depth))
+            try:
+                self._write(snap)
+                with self.lock:
+                    self.written += 1
+                    self._last_durable_step = snap.step
+            except BaseException as exc:  # never escapes into training
+                with self.lock:
+                    self.failures += 1
+                telemetry.counter("ckpt_write_failures_total")
+                telemetry.emit("checkpoint", step=snap.step, seconds=0.0,
+                               tier="t0", error=f"{type(exc).__name__}: {exc}")
+                self.logger.warning(
+                    "ckpt_async: background write for step %d failed: %s",
+                    snap.step, exc)
+                from ..telemetry import flight
+
+                flight.auto_dump("checkpoint")
+            finally:
+                with self.lock:
+                    self._inflight = None
+                    self.cv.notify_all()
+
+    def _write(self, snap: Snapshot):
+        from . import chaos as chaos_mod
+
+        chaos_mod.maybe_raise("ckpt.async_write",
+                              OSError("chaos: async checkpoint write lost"))
+        ckpt_mod.save_sharded(
+            self.directory, snap.step, snap.state.get("params", {}),
+            aux=snap.state.get("aux"), symbol=snap.symbol,
+            extra_meta=snap.meta, opt_state=snap.state.get("opt"),
+            comm_state=snap.state.get("comm"), tier="t0")
+        if self.keep_last_k > 0:
+            ckpt_mod.prune_steps(self.directory, self.keep_last_k)
+
+
+class ReplicaStore:
+    """T1: the in-memory peer tier for the in-process virtual world.
+
+    Each origin rank's newest snapshot is held by its neighbor
+    ``(rank + 1) % world``; ``replicate`` is (rank, seq)-deduped —
+    exactly-once per (origin, step) like kvstore pushes — and ``restore``
+    returns the freshest snapshot whose HOLDER is still alive. A resize
+    that keeps any holder alive therefore restores from RAM with no disk
+    read; ``drop_rank`` forgets everything a departed rank held so a
+    rejoin cannot resurrect stale state."""
+
+    def __init__(self, world_size):
+        self.world_size = int(world_size)
+        self.lock = named_lock("ckpt_async.ReplicaStore")
+        self._entries = {}  # origin rank -> {"seq", "holder", "snap"}
+        self.duplicate_count = 0
+
+    def holder_of(self, rank):
+        return (int(rank) + 1) % self.world_size if self.world_size > 1 \
+            else int(rank)
+
+    def replicate(self, rank, snap: Snapshot):
+        """Ship ``rank``'s snapshot to its neighbor. Stale or duplicate
+        (seq <= stored seq) replicas are dropped, mirroring the kvstore
+        server's at-least-once dedup."""
+        from .. import telemetry
+
+        rank = int(rank)
+        with self.lock:
+            ent = self._entries.get(rank)
+            if ent is not None and snap.step <= ent["seq"]:
+                self.duplicate_count += 1
+                return False
+            self._entries[rank] = {"seq": snap.step,
+                                   "holder": self.holder_of(rank),
+                                   "snap": snap}
+        telemetry.counter("ckpt_replicas_total")
+        return True
+
+    def restore(self, alive=None):
+        """Freshest snapshot whose holder survives in ``alive`` (an
+        iterable of ranks; None = everyone), or None → fall back to T2."""
+        alive_set = None if alive is None else {int(r) for r in alive}
+        best = None
+        with self.lock:
+            for ent in self._entries.values():
+                if alive_set is not None and ent["holder"] not in alive_set:
+                    continue
+                if best is None or ent["seq"] > best["seq"]:
+                    best = ent
+        return None if best is None else best["snap"]
+
+    def drop_rank(self, rank):
+        """A rank died: its RAM — and every replica it held — is gone."""
+        rank = int(rank)
+        with self.lock:
+            self._entries.pop(rank, None)
+            for origin in [o for o, e in self._entries.items()
+                           if e["holder"] == rank]:
+                del self._entries[origin]
+
+
+def save_now(directory, step, params, aux=None, symbol=None,
+             extra_meta=None, opt_state=None, comm_state=None, tier="t2",
+             keep=None):
+    """Synchronous durable save through the checkpoint plane — the
+    blocking path for moments that must not race the writer queue
+    (preemption flush, elastic floor, epoch end). Same atomic format and
+    telemetry as the writer's background path. ``keep`` > 0 runs the
+    retention GC after the write — callers that hold the plane's only
+    writer (queue drained, cadence submits on this thread) pass their
+    resolved ``keep_last_k`` so epoch-end saves don't leave K+1 dirs."""
+    out = ckpt_mod.save_sharded(
+        directory, step, params, aux=aux, symbol=symbol,
+        extra_meta=extra_meta, opt_state=opt_state,
+        comm_state=comm_state, tier=tier)
+    if keep is not None and keep > 0:
+        ckpt_mod.prune_steps(directory, keep)
+    return out

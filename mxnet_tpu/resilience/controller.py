@@ -76,6 +76,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import math
 import os
 import threading
 import time
@@ -159,6 +160,27 @@ def choose_world(perf, current, lo, hi, margin=0.1):
     return current
 
 
+def select_ckpt_cadence(save_seconds, step_seconds, current,
+                        target_overhead=0.05, floor=1, cap=1024):
+    """Checkpoint cadence (steps between snapshots) so the measured save
+    cost stays near ``target_overhead`` of training time: cadence ~=
+    save_seconds / (target * step_seconds), clamped to [floor, cap].
+
+    Hysteresis: a move smaller than 25% of the current cadence returns
+    ``current`` — noise in one save measurement must not thrash the
+    cadence (and with it the recovery window). None in -> ``current``
+    out (no data, no opinion)."""
+    current = max(int(current), 1)
+    if not save_seconds or not step_seconds or step_seconds <= 0:
+        return current
+    target = max(float(target_overhead), 1e-6)
+    ideal = float(save_seconds) / (target * float(step_seconds))
+    proposed = min(max(int(math.ceil(ideal)), int(floor)), int(cap))
+    if abs(proposed - current) < max(1, int(0.25 * current)):
+        return current
+    return proposed
+
+
 class FleetControllerConfig:
     """Knobs of the policy loop; defaults are production-shaped (tests
     shrink the clocks). See the module docstring for what each lever and
@@ -169,7 +191,8 @@ class FleetControllerConfig:
                  max_evictions=2, rejoin_after=30.0, cooldowns=None,
                  max_actions_per_hour=12, min_world=None, chip_budget=None,
                  auto_evict=True, auto_backfill=True, auto_tier=True,
-                 auto_world=False,
+                 auto_world=False, auto_ckpt=True,
+                 ckpt_target_overhead=0.05,
                  world_margin=0.1, regress_tolerance=0.25,
                  evaluate_after=10.0, ewma_alpha=0.5, wire_gbps=None,
                  breaker=None):
@@ -186,7 +209,7 @@ class FleetControllerConfig:
         self.max_evictions = int(max_evictions)
         self.rejoin_after = float(rejoin_after)
         self.cooldowns = {"evict": 30.0, "backfill": 5.0, "retier": 60.0,
-                          "world": 120.0}
+                          "world": 120.0, "ckpt": 60.0}
         if cooldowns:
             self.cooldowns.update(cooldowns)
         self.max_actions_per_hour = int(max_actions_per_hour)
@@ -196,6 +219,8 @@ class FleetControllerConfig:
         self.auto_backfill = bool(auto_backfill)
         self.auto_tier = bool(auto_tier)
         self.auto_world = bool(auto_world)
+        self.auto_ckpt = bool(auto_ckpt)
+        self.ckpt_target_overhead = float(ckpt_target_overhead)
         self.world_margin = float(world_margin)
         self.regress_tolerance = float(regress_tolerance)
         self.evaluate_after = float(evaluate_after)
@@ -250,6 +275,8 @@ class FleetController:
         self._last_action = {}        # lever -> monotonic ts
         self._last_decision = {}      # lever -> (action, outcome) dedupe
         self._pending_retier = None
+        self._ckpt_every = None       # live cadence; None = lever disarmed
+        self._pending_ckpt = None
         # [{"lever","action","baseline","deadline"}]: every actuation
         # gets its regression check, even when actions cluster inside
         # one evaluate_after window (bounded: rate limiter caps arrivals)
@@ -290,7 +317,7 @@ class FleetController:
 
     def bind(self, coordinator=None, model_key=None, world_size=None,
              comm_mode="none", can_retier=False, fp32_wire_bytes=0.0,
-             health=None, logger=None):
+             health=None, ckpt_every=None, logger=None):
         """Attach the controller to one run's levers and identity. The
         membership levers need a ``coordinator``; without one they stay
         disabled (logged). ``fp32_wire_bytes`` is the closed-form per-step
@@ -323,6 +350,11 @@ class FleetController:
                         rank, {"t": time.monotonic(),
                                "reason": "pre-bind"})
             self._pending_retier = None
+            # checkpoint-cadence lever (ISSUE 17): armed only when the
+            # run checkpoints per-step (ckpt_every is the live cadence)
+            self._ckpt_every = None if ckpt_every is None \
+                else max(1, int(ckpt_every))
+            self._pending_ckpt = None
         self.detector.attach()
         if coordinator is None and (self.cfg.auto_evict or
                                     self.cfg.auto_world):
@@ -338,6 +370,8 @@ class FleetController:
             self._co = None
             self._health = None
             self._pending_retier = None
+            self._pending_ckpt = None
+            self._ckpt_every = None
         self.detector.detach()
 
     def start(self, interval=None):
@@ -556,6 +590,8 @@ class FleetController:
                 self._lever_retier(now)
             if self.cfg.auto_world and self._co is not None:
                 self._lever_world(now)
+            if self.cfg.auto_ckpt and self._ckpt_every is not None:
+                self._lever_ckpt(now)
             if self._health is not None:
                 self._lever_health()
             return report
@@ -742,6 +778,45 @@ class FleetController:
                   perf={str(k): round(v, 6)
                         for k, v in self._world_perf.items()})
 
+    def _lever_ckpt(self, now):
+        """Checkpoint-cadence lever (ISSUE 17): widen/narrow the snapshot
+        cadence so the MEASURED save cost (the ``checkpoint_save_seconds``
+        hub histogram: T0 snapshot stall + background write wall) tracks
+        ``cfg.ckpt_target_overhead`` of step time. Staged like retier —
+        the fit loop owns the live cadence and applies via
+        :meth:`take_ckpt_cadence` — and recommend-capable: dry-run mode
+        emits the move without staging it."""
+        from .. import telemetry
+
+        if self._pending_ckpt is not None:
+            return
+        report = self._last_report
+        step_s = None
+        if report:
+            ranks = report["membership"]["final_ranks"] or \
+                sorted(report["ranks"])
+            meds = sorted(report["ranks"][r]["median_step_seconds"]
+                          for r in ranks if r in report["ranks"])
+            step_s = meds[len(meds) // 2] if meds else None
+        hist = telemetry.hub().snapshot()["histograms"].get(
+            "checkpoint_save_seconds")
+        save_s = (hist["sum"] / hist["count"]) if hist and hist["count"] \
+            else None
+        target = select_ckpt_cadence(
+            save_s, step_s, self._ckpt_every,
+            target_overhead=self.cfg.ckpt_target_overhead)
+        if target == self._ckpt_every:
+            return
+
+        def stage():
+            self._pending_ckpt = {"every": int(target)}
+
+        self._act("ckpt",
+                  f"ckpt cadence {self._ckpt_every} -> {target}", stage,
+                  now, every=int(target),
+                  save_seconds=None if save_s is None else round(save_s, 4),
+                  step_seconds=None if step_s is None else round(step_s, 4))
+
     def _health_ctx(self):
         """Model-health decision context: the currently-blamed layer (if
         the health monitor flagged one recently). Attached to evict and
@@ -776,6 +851,26 @@ class FleetController:
         with self._lock:
             action, self._pending_retier = self._pending_retier, None
             return action
+
+    def take_ckpt_cadence(self):
+        """Pop the staged cadence change (or None); the fit loop applies
+        it host-side (the cadence is a pure step-loop counter, no
+        recompile) and reports back via :meth:`ckpt_cadence_applied`."""
+        with self._lock:
+            action, self._pending_ckpt = self._pending_ckpt, None
+            return action
+
+    def ckpt_cadence_applied(self, action):
+        """The fit loop adopted the staged checkpoint cadence."""
+        from .. import telemetry
+
+        with self._lock:
+            self._ckpt_every = max(1, int(action["every"]))
+            telemetry.gauge("controller_ckpt_cadence",
+                            float(self._ckpt_every))
+            telemetry.emit("controller", lever="ckpt",
+                           action=f"applied every {self._ckpt_every}",
+                           outcome="applied", dry_run=False)
 
     def retier_applied(self, action, seconds):
         """The fit loop rebuilt + rewarmed the fused step on the new

@@ -37,7 +37,7 @@ EVENT_GOLDEN_KEYS = {
     "step_event": ("span_kind", "epoch", "step", "name"),
     "badput": ("reason", "seconds"),
     "epoch_summary": ("epoch", "steps", "seconds"),
-    "checkpoint": ("step", "seconds"),
+    "checkpoint": ("step", "seconds", "tier"),
     "retry": ("op", "attempt"),
     "circuit_open": ("op",),
     "monitor": ("rows",),
@@ -146,6 +146,10 @@ def read_events(path):
             row.setdefault("finite", True)
         elif row.get("kind") == "health_anomaly":
             row.setdefault("layer", None)
+        elif row.get("kind") == "checkpoint":
+            # pre-PR-17 rows predate the multi-tier plane: everything was
+            # a synchronous durable-disk save
+            row.setdefault("tier", "t2")
         elif row.get("kind") == "profile":
             # rows from early/hand-rolled producers (ISSUE 15): fill the
             # additive fields so the CLI/diff consume old streams uniformly

@@ -52,7 +52,7 @@ FLIGHT_FORMAT = 1
 INCIDENT_KINDS = frozenset({
     "retry", "circuit_open", "step_event", "server_dedup", "watchdog",
     "chaos", "badput", "guard_trip", "preempt", "memory_leak", "lockwatch",
-    "controller", "breaker", "health_anomaly",
+    "controller", "breaker", "health_anomaly", "checkpoint",
 })
 
 

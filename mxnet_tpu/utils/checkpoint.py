@@ -38,7 +38,7 @@ import jax
 import numpy as np
 
 __all__ = ["save_sharded", "load_sharded", "load_resharded", "latest_step",
-           "validate_step"]
+           "validate_step", "prune_steps", "atomic_write", "check_sidecar"]
 
 _STATE_DIR = "state"
 _SYMBOL_FILE = "symbol.json"
@@ -65,19 +65,24 @@ def _file_crc32(path, chunk=1 << 20):
 
 def _write_manifest(step_dir, step):
     """Record size + CRC32 of every file in the step dir (manifest and
-    metadata excluded: metadata is written after, manifest can't self-hash)."""
+    metadata excluded: metadata is written after, manifest can't self-hash).
+    Returns the total manifested bytes (for the ``ckpt_bytes_written``
+    accounting)."""
     files = {}
+    total = 0
     for dirpath, _dirnames, filenames in os.walk(step_dir):
         for name in sorted(filenames):
             if name in (_MANIFEST_FILE, _META_FILE):
                 continue
             full = os.path.join(dirpath, name)
             rel = os.path.relpath(full, step_dir)
-            files[rel] = {"size": os.path.getsize(full),
-                          "crc32": _file_crc32(full)}
+            size = os.path.getsize(full)
+            files[rel] = {"size": size, "crc32": _file_crc32(full)}
+            total += size
     manifest = {"format": 1, "step": int(step), "files": files}
     with open(os.path.join(step_dir, _MANIFEST_FILE), "w") as f:
         json.dump(manifest, f)
+    return total
 
 
 def _chaos_corrupt(step_dir):
@@ -108,7 +113,8 @@ def _chaos_corrupt(step_dir):
 
 
 def save_sharded(directory, step, params, aux=None, symbol=None,
-                 extra_meta=None, opt_state=None, comm_state=None):
+                 extra_meta=None, opt_state=None, comm_state=None,
+                 tier="t2"):
     """Atomically write a sharded checkpoint for ``step`` under ``directory``.
 
     params/aux may hold jax.Arrays sharded over a live mesh — each process
@@ -130,12 +136,15 @@ def save_sharded(directory, step, params, aux=None, symbol=None,
 
     t0 = telemetry.hub().now()
     with telemetry.phase("checkpoint_save"):
-        out = _save_sharded(directory, step, params, aux=aux, symbol=symbol,
-                            extra_meta=extra_meta, opt_state=opt_state,
-                            comm_state=comm_state)
+        out, nbytes = _save_sharded(
+            directory, step, params, aux=aux, symbol=symbol,
+            extra_meta=extra_meta, opt_state=opt_state,
+            comm_state=comm_state)
     telemetry.counter("checkpoint_saves_total")
+    if nbytes:
+        telemetry.counter("ckpt_bytes_written", float(nbytes))
     telemetry.emit("checkpoint", step=int(step),
-                   seconds=telemetry.hub().now() - t0)
+                   seconds=telemetry.hub().now() - t0, tier=str(tier))
     return out
 
 
@@ -170,10 +179,11 @@ def _save_sharded(directory, step, params, aux=None, symbol=None,
 
         # every process's shards must be on disk before rank 0 manifests
         multihost_utils.sync_global_devices("mxtpu_ckpt_state_done")
+    total_bytes = 0
     if jax.process_index() == 0:
         if symbol is not None:
             symbol.save(os.path.join(tmp_dir, _SYMBOL_FILE))
-        _write_manifest(tmp_dir, step)
+        total_bytes = _write_manifest(tmp_dir, step)
         meta = {"step": step}
         meta.update(extra_meta or {})
         with open(os.path.join(tmp_dir, _META_FILE), "w") as f:
@@ -192,7 +202,7 @@ def _save_sharded(directory, step, params, aux=None, symbol=None,
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("mxtpu_ckpt_commit")
-    return step_dir
+    return step_dir, total_bytes
 
 
 def validate_step(directory, step, verify=None):
@@ -343,3 +353,95 @@ def load_resharded(directory, mesh, step=None):
               for k, v in params.items()}
     aux = {k: jax.device_put(np.asarray(v), repl) for k, v in aux.items()}  # mxlint: disable=MX805 - checkpoint restore replicates onto the mesh before the partitioner re-places
     return params, aux, symbol, meta, opt_leaves, comm_state
+
+
+_GC_PREFIX = ".gc."
+
+
+def prune_steps(directory, keep_last_k, verify=None):
+    """Retention GC: delete step dirs older than the ``keep_last_k`` newest
+    *valid* steps. Returns the list of pruned step ids.
+
+    Race-safety contract with ``latest_step``: a victim is first renamed to
+    a hidden ``.gc.<step>`` name — one atomic op that removes it from the
+    digit-named scan — and only then rmtree'd, so a concurrent scanner
+    either sees the step whole or not at all (never a half-deleted dir that
+    would shadow an older valid step). Only steps strictly older than the
+    k-th newest valid step are touched: a torn newer dir is left for
+    ``latest_step`` to warn about, never silently reaped while it might
+    still be the write in flight.
+    """
+    import shutil
+
+    directory = os.path.abspath(os.fspath(directory))
+    keep_last_k = int(keep_last_k)
+    if keep_last_k <= 0 or not os.path.isdir(directory):
+        return []
+    steps = sorted((int(d) for d in os.listdir(directory) if d.isdigit()),
+                   reverse=True)
+    valid = [s for s in steps if validate_step(directory, s, verify=verify)]
+    if len(valid) <= keep_last_k:
+        return []
+    cutoff = valid[keep_last_k - 1]
+    pruned = []
+    for step in steps:
+        if step >= cutoff:
+            continue
+        trash = os.path.join(directory, f"{_GC_PREFIX}{step}")
+        try:
+            os.rename(os.path.join(directory, str(step)), trash)
+            shutil.rmtree(trash, ignore_errors=True)
+            pruned.append(step)
+        except OSError:  # pragma: no cover - concurrent pruner/rename loss
+            continue
+    # leftover .gc.* from a pruner killed between rename and rmtree
+    for d in os.listdir(directory):
+        if d.startswith(_GC_PREFIX):
+            shutil.rmtree(os.path.join(directory, d), ignore_errors=True)
+    return pruned
+
+
+def atomic_write(path, writer):
+    """Crash-safe single-file write for the legacy (non-sharded) format.
+
+    ``writer(tmp_path)`` produces the file at a hidden temp name in the
+    destination directory; this helper then records a ``<path>.crc32``
+    sidecar ({"size", "crc32"}) and commits both with ``os.replace`` —
+    the same tmp+rename+CRC discipline the sharded tier uses, so the
+    legacy ``save_checkpoint`` path can no longer tear. Commit order is
+    file first, sidecar second: a kill between the two leaves a stale
+    sidecar that load reports as corruption (fail loud) rather than a
+    silently torn params file (fail wrong).
+    """
+    path = os.path.abspath(os.fspath(path))
+    dirname = os.path.dirname(path) or "."
+    tmp = os.path.join(dirname, f"{_TMP_PREFIX}{os.path.basename(path)}")
+    writer(tmp)
+    info = {"size": os.path.getsize(tmp), "crc32": _file_crc32(tmp)}
+    side_tmp = tmp + ".crc32"
+    with open(side_tmp, "w") as f:
+        json.dump(info, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    os.replace(side_tmp, path + ".crc32")
+    return path
+
+
+def check_sidecar(path):
+    """Validate a file against its ``atomic_write`` CRC sidecar.
+
+    Returns True (sidecar present and matching), False (present but size
+    or CRC mismatch — the file is torn or corrupt), or None (no sidecar:
+    a pre-PR-17 legacy file, accepted as-is)."""
+    path = os.path.abspath(os.fspath(path))
+    side = path + ".crc32"
+    if not os.path.exists(side):
+        return None
+    try:
+        with open(side) as f:
+            info = json.load(f)
+        return (os.path.getsize(path) == int(info["size"])
+                and _file_crc32(path) == int(info["crc32"]))
+    except (OSError, ValueError, KeyError, TypeError):
+        return False

@@ -230,6 +230,41 @@ def test_bench_elastic_smoke_json_contract():
     assert blob["smoke"] is True  # smoke runs never write BENCH_ELASTIC_*
 
 
+def test_bench_ckpt_smoke_json_contract():
+    """--ckpt-bench --smoke is the CI guard on the async-checkpoint bench
+    entry (ISSUE 17): one JSON line with the contract keys, the async
+    step stall under the 10%-of-sync acceptance bound, both recovery
+    tiers exercised (peer RAM restore + chaos-forced disk fallback), and
+    checkpoint badput priced at all three cadences."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--ckpt-bench", "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    blob = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "async_stall_ms", "sync_save_ms", "peer_recovery_s",
+                "disk_recovery_s", "badput_by_cadence"):
+        assert key in blob, blob
+    assert blob["metric"] == "ckpt_async_stall_pct_of_sync"
+    # ACCEPTANCE: the async save stalls the step loop <10% of a sync save
+    assert 0 < blob["value"] < 10.0, blob
+    assert blob["async_stall_ms"] < blob["sync_save_ms"]
+    # both recovery paths ran: T1 with replication live, T2 under chaos
+    assert blob["peer_recovery_tier"] == "t1"
+    assert blob["disk_recovery_tier"] == "t2"
+    assert blob["peer_recovery_s"] > 0 and blob["disk_recovery_s"] > 0
+    # badput priced at every cadence, monotone non-increasing with cadence
+    rows = blob["badput_by_cadence"]
+    assert set(rows) == {"1", "4", "16"}
+    assert all(r["badput_s_per_epoch"] >= 0 for r in rows.values())
+    assert rows["16"]["badput_s_per_epoch"] <= rows["1"]["badput_s_per_epoch"]
+    assert blob["smoke"] is True  # smoke runs never write BENCH_CKPT_*
+
+
 def test_bench_controller_smoke_json_contract():
     """--controller-bench --smoke is the CI guard on the fleet-controller
     bench entry (ISSUE 12): one JSON line with the contract keys, the

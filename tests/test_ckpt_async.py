@@ -1,0 +1,619 @@
+"""Asynchronous multi-tier checkpointing tests (ISSUE 17).
+
+Covers: the T0 bounded-queue background writer (drain, drop-oldest
+backpressure, failure-as-incident), retention GC racing latest_step, the
+T1 in-memory peer tier (ReplicaStore dedup/restore/drop + the kvstore
+`replica` wire op on both the in-process group server and the dist_async
+socket host), legacy save_checkpoint/load_checkpoint atomicity with CRC
+sidecars, and the acceptance scenarios: a mid-epoch kill resuming
+step-granular and bitwise-equal to a checkpoint-replay reference (torn
+T2 dirs skipped), an elastic resize restoring from the peer tier with no
+disk read (disk fallback chaos-proven), the controller's cadence lever,
+and the zero-recompile invariant with async checkpointing stacked on the
+full feature set.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.resilience import (AsyncCheckpointWriter, ElasticCoordinator,
+                                  FleetController, ReplicaStore, chaos_scope)
+from mxnet_tpu.resilience import ckpt_async
+from mxnet_tpu.telemetry import flight
+from mxnet_tpu.utils import checkpoint as ckpt_mod
+from mxnet_tpu.utils import compile as cm
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    """These tests count checkpoint events/gauges/histograms: isolate the
+    hub, and keep elastic commit()'s world relabeling from leaking."""
+    prev = (telemetry.current_rank(), telemetry.world_size())
+    telemetry.reset()
+    yield
+    telemetry.set_world(*prev)
+    telemetry.reset()
+
+
+def _ctx(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+    return [mx.cpu(i) for i in range(n)]
+
+
+def _mlp(hidden=16, classes=2):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=hidden)
+    net = mx.sym.Activation(data=net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _blobs(n=480, dim=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.concatenate([rng.randn(n // 2, dim) + 1,
+                        rng.randn(n - n // 2, dim) - 1]).astype(np.float32)
+    y = np.concatenate([np.zeros(n // 2), np.ones(n - n // 2)]).astype(
+        np.float32)
+    order = rng.permutation(n)
+    return X[order], y[order]
+
+
+def _host_params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"fc1_weight": rng.randn(16, 8).astype(np.float32),
+            "fc1_bias": rng.randn(16).astype(np.float32)}
+
+
+def _snap(step, seed=None):
+    return ckpt_async.capture_snapshot(
+        step, _host_params(seed if seed is not None else step),
+        meta={"epoch": 0, "num_update": step})
+
+
+def _copy_steps(src, dst, steps):
+    os.makedirs(dst, exist_ok=True)
+    for step in steps:
+        shutil.copytree(os.path.join(src, str(step)),
+                        os.path.join(dst, str(step)))
+
+
+# -- cadence / retention / queue resolution ------------------------------------
+
+def test_resolvers_argument_beats_env(monkeypatch):
+    assert ckpt_async.resolve_every(None) is None     # unarmed by default
+    assert ckpt_async.resolve_every(7) == 7
+    assert ckpt_async.resolve_every(0) == 1           # floor, not disable
+    monkeypatch.setenv("MXNET_TPU_CKPT_STEPS", "12")
+    assert ckpt_async.resolve_every(None) == 12
+    assert ckpt_async.resolve_every(3) == 3           # explicit arg wins
+
+    assert ckpt_async.resolve_keep(None) == 3
+    monkeypatch.setenv("MXNET_TPU_CKPT_KEEP", "9")
+    assert ckpt_async.resolve_keep(None) == 9
+    assert ckpt_async.resolve_keep(0) == 0            # 0 = never prune
+
+    assert ckpt_async.resolve_queue_depth(None) == 2
+    monkeypatch.setenv("MXNET_TPU_CKPT_QUEUE", "5")
+    assert ckpt_async.resolve_queue_depth(None) == 5
+
+
+def test_capture_snapshot_is_host_side_and_priced():
+    mesh = make_mesh(dp=8)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params = {"w": jax.device_put(np.ones((8, 4), np.float32),
+                                  NamedSharding(mesh, P("dp")))}
+    opt = {"m": jnp.zeros((8, 4))}
+    before = telemetry.hub().snapshot()["histograms"].get(
+        "checkpoint_save_seconds", {"count": 0})["count"]
+    snap = ckpt_async.capture_snapshot(5, params, opt_state=opt,
+                                       meta={"num_update": 5})
+    # everything host numpy: the snapshot can outlive mesh/devices
+    assert isinstance(snap.state["params"]["w"], np.ndarray)
+    assert isinstance(snap.state["opt"][0], np.ndarray)
+    assert snap.step == 5 and snap.meta["num_update"] == 5
+    after = telemetry.hub().snapshot()["histograms"][
+        "checkpoint_save_seconds"]["count"]
+    assert after == before + 1  # the stall priced into checkpoint badput
+
+
+# -- T0: the background writer -------------------------------------------------
+
+def test_writer_drains_and_prunes(tmp_path):
+    w = AsyncCheckpointWriter(tmp_path, queue_depth=8, keep_last_k=2)
+    try:
+        for step in (1, 2, 3, 4, 5):
+            assert w.submit(_snap(step))
+        assert w.flush(timeout=30)
+        assert w.written == 5 and w.dropped == 0
+        assert w.last_durable_step == 5
+    finally:
+        w.close()
+    # retention: only the newest keep_last_k steps survive on disk
+    kept = sorted(int(d) for d in os.listdir(tmp_path) if d.isdigit())
+    assert kept == [4, 5]
+    assert ckpt_mod.latest_step(tmp_path) == 5
+    loaded, _, _, meta, _ = ckpt_mod.load_sharded(tmp_path)
+    np.testing.assert_array_equal(loaded["fc1_weight"],
+                                  _host_params(5)["fc1_weight"])
+    assert meta["num_update"] == 5
+
+
+def test_writer_backpressure_drops_oldest_never_blocks(tmp_path,
+                                                       monkeypatch):
+    gate = threading.Event()
+    real = ckpt_mod.save_sharded
+
+    def slow_save(*a, **kw):
+        gate.wait(timeout=30)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ckpt_mod, "save_sharded", slow_save)
+    w = AsyncCheckpointWriter(tmp_path, queue_depth=2, keep_last_k=0)
+    try:
+        w.submit(_snap(1))           # picked up, stalls on the gate
+        time.sleep(0.05)
+        for step in (2, 3, 4, 5):
+            t0 = time.monotonic()
+            w.submit(_snap(step))    # never blocks the producer
+            assert time.monotonic() - t0 < 1.0
+        gate.set()
+        assert w.flush(timeout=30)
+    finally:
+        gate.set()
+        w.close()
+    # oldest pending snapshots were sacrificed, the freshest survived
+    assert w.dropped == 2 and w.written == 3
+    kept = sorted(int(d) for d in os.listdir(tmp_path) if d.isdigit())
+    assert kept == [1, 4, 5]
+
+
+def test_writer_failure_is_incident_not_exception(tmp_path, monkeypatch):
+    """A dead disk must not kill training: the write failure is counted,
+    emitted as a `checkpoint` incident (golden keys intact) and flight-
+    dumped CRC-clean — and the NEXT write works again."""
+    flight_d = tmp_path / "flight"
+    flight_d.mkdir()
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(flight_d))
+    d = tmp_path / "ckpt"
+    w = AsyncCheckpointWriter(d, queue_depth=2, keep_last_k=0)
+    try:
+        with chaos_scope(seed=0, rules={"ckpt.async_write": 1.0}):
+            w.submit(_snap(1))
+            assert w.flush(timeout=30)
+        assert w.failures == 1 and w.written == 0
+        # chaos off: the writer thread survived and keeps writing
+        w.submit(_snap(2))
+        assert w.flush(timeout=30)
+        assert w.written == 1 and w.last_durable_step == 2
+    finally:
+        w.close()
+    incidents = [e for e in telemetry.hub().events("checkpoint")
+                 if e.get("error")]
+    assert incidents and incidents[0]["tier"] == "t0"
+    for key in ("step", "seconds", "tier"):    # golden keys even on error
+        assert key in incidents[0]
+    dumps = list(flight_d.glob("flight-*-checkpoint-*.json"))
+    assert dumps
+    ok, msg = flight.validate_flight(str(dumps[0]))
+    assert ok, msg
+
+
+def test_prune_never_races_latest_step(tmp_path):
+    """`latest_step` readers must always see a loadable step while the
+    pruner is deleting: the pruner renames a victim out of the numeric
+    namespace (one atomic op) before rmtree, so a concurrent scan never
+    observes a half-deleted step dir."""
+    params = _host_params()
+    for step in range(1, 6):
+        ckpt_mod.save_sharded(tmp_path, step, params)
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            step = ckpt_mod.latest_step(tmp_path)
+            if step is None:
+                errors.append("latest_step saw no valid step")
+                return
+            if not ckpt_mod.validate_step(tmp_path, step):
+                errors.append(f"latest_step returned torn step {step}")
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for step in range(6, 40):
+            ckpt_mod.save_sharded(tmp_path, step, params)
+            ckpt_mod.prune_steps(tmp_path, 2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    kept = sorted(int(d) for d in os.listdir(tmp_path) if d.isdigit())
+    assert kept == [38, 39]
+    # no .gc. trash left behind either
+    assert not [d for d in os.listdir(tmp_path) if d.startswith(".gc.")]
+
+
+def test_prune_ignores_torn_dirs_when_counting(tmp_path):
+    """Retention counts VALID steps: a torn dir must not displace a good
+    checkpoint out of the keep window."""
+    params = _host_params()
+    for step in (1, 2, 3):
+        ckpt_mod.save_sharded(tmp_path, step, params)
+    os.makedirs(tmp_path / "9")               # torn: bare numeric dir
+    ckpt_mod.prune_steps(tmp_path, 2)
+    kept = sorted(int(d) for d in os.listdir(tmp_path) if d.isdigit())
+    assert 2 in kept and 3 in kept            # both valid keeps survive
+
+
+# -- T1: the in-memory peer tier -----------------------------------------------
+
+def test_replica_store_dedup_restore_drop():
+    store = ReplicaStore(4)
+    assert store.holder_of(0) == 1 and store.holder_of(3) == 0
+    assert store.replicate(0, _snap(3))
+    assert store.replicate(1, _snap(5))
+    # stale and duplicate replicas are dropped, like kvstore pushes
+    assert not store.replicate(0, _snap(3))
+    assert not store.replicate(0, _snap(2))
+    assert store.duplicate_count == 2
+    # freshest snapshot whose holder survives
+    assert store.restore().step == 5
+    # rank 1's snapshot is held by rank 2; kill 2 -> only rank 0's left
+    assert store.restore(alive=(0, 1, 3)).step == 3
+    # kill every holder -> T2 fallback
+    assert store.restore(alive=(0,)) is None
+    # a dead rank takes its own entry AND everything it held with it
+    store.drop_rank(2)
+    assert store.restore() .step == 3
+    store.drop_rank(1)                        # holder of rank 0's snap
+    assert store.restore() is None
+
+
+def test_group_kv_replica_roundtrip():
+    from mxnet_tpu import kvstore as kvstore_mod
+
+    srv = kvstore_mod._GroupServer(4)
+    workers = [kvstore_mod._GroupWorkerKVStore(srv, r) for r in range(4)]
+    payload = {"state": {"params": _host_params()}, "meta": {"step": 7}}
+    assert workers[0].push_replica(0, 7, payload)
+    # stale step dropped, newest wins
+    assert not workers[0].push_replica(0, 6, payload)
+    assert srv.replica_count == 1 and srv.replica_duplicate_count == 1
+    step, got = workers[2].pull_replica(0)
+    assert step == 7
+    np.testing.assert_array_equal(got["state"]["params"]["fc1_weight"],
+                                  payload["state"]["params"]["fc1_weight"])
+    assert workers[1].pull_replica(3) is None
+
+
+def test_async_server_replica_op_dedup(monkeypatch):
+    """The dist_async wire path: `replica` is newest-wins by step and
+    (rank, seq)-replay-deduped like pushes, `replica_pull` returns the
+    held blob, and `stats` exposes the replica count."""
+    monkeypatch.setenv("MXNET_TPU_KV_OP_TIMEOUT", "2.0")
+    import socket
+
+    from mxnet_tpu.kvstore_async import (_MAGIC, _AsyncServer, _recv_exact,
+                                         _recv_msg, _send_msg)
+
+    srv = _AsyncServer("127.0.0.1", 0, 1)
+    port = srv._srv.getsockname()[1]
+
+    def connect():
+        s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        s.sendall(_MAGIC)
+        assert _recv_exact(s, 4) == _MAGIC
+        return s
+
+    def call(s, *msg):
+        _send_msg(s, msg)
+        return _recv_msg(s)
+
+    import pickle
+
+    blob = pickle.dumps({"step7": True}, protocol=pickle.HIGHEST_PROTOCOL)
+    c = connect()
+    try:
+        assert call(c, "replica", 0, 7, blob, 0, 1) == ("ok", True)
+        # an at-least-once RESEND of the same (rank, seq) replays the
+        # recorded reply without re-applying
+        assert call(c, "replica", 0, 7, blob, 0, 1) == ("ok", True)
+        assert srv.replica_count == 1
+        # a NEW request carrying an older step is dropped as stale
+        assert call(c, "replica", 0, 5, blob, 0, 2) == ("ok", False)
+        op, ent = call(c, "replica_pull", 0)
+        assert op == "ok" and ent[0] == 7
+        assert pickle.loads(ent[1]) == {"step7": True}
+        assert call(c, "replica_pull", 3) == ("ok", None)
+        assert call(c, "stats")[1]["replica_count"] == 1
+    finally:
+        c.close()
+        srv._srv.close()
+
+
+# -- satellite: legacy save/load on the atomic CRC writer ----------------------
+
+def test_legacy_checkpoint_atomic_with_crc_sidecar(tmp_path):
+    prefix = str(tmp_path / "legacy")
+    data = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=4)
+    arg = {"fc1_weight": mx.nd.array(np.random.randn(4, 3)),
+           "fc1_bias": mx.nd.array(np.zeros(4))}
+    mx.model.save_checkpoint(prefix, 3, sym, arg, {})
+    # sidecars committed next to both artifacts, no tmp files left
+    assert os.path.exists(prefix + "-0003.params.crc32")
+    assert os.path.exists(prefix + "-symbol.json.crc32")
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    sym2, arg2, _ = mx.model.load_checkpoint(prefix, 3)
+    np.testing.assert_array_equal(arg2["fc1_weight"].asnumpy(),
+                                  arg["fc1_weight"].asnumpy())
+    assert sym2.list_arguments() == sym.list_arguments()
+
+    # torn params file: the CRC sidecar catches it loudly
+    with open(prefix + "-0003.params", "r+b") as f:
+        f.truncate(os.path.getsize(prefix + "-0003.params") - 1)
+    assert ckpt_mod.check_sidecar(prefix + "-0003.params") is False
+    with pytest.raises(MXNetError):
+        mx.model.load_checkpoint(prefix, 3)
+
+
+def test_atomic_write_helper(tmp_path):
+    path = str(tmp_path / "blob.bin")
+    ckpt_mod.atomic_write(path, lambda tmp: open(tmp, "wb").write(b"x" * 64))
+    assert ckpt_mod.check_sidecar(path) is True
+    with open(path + ".crc32") as f:
+        side = json.load(f)
+    assert side["size"] == 64
+    # legacy file with no sidecar: accepted (None, not False)
+    bare = str(tmp_path / "old.bin")
+    with open(bare, "wb") as f:
+        f.write(b"y")
+    assert ckpt_mod.check_sidecar(bare) is None
+
+
+# -- fit integration: cadence, telemetry, resume -------------------------------
+
+def test_fit_step_cadence_writes_and_gauges(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_CKPT_KEEP", "100")
+    X, y = _blobs(n=256)
+    d = str(tmp_path / "ckpt")
+    m = mx.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=2, optimizer="sgd",
+                       learning_rate=0.1)
+    m.fit(mx.io.NDArrayIter(X, y, batch_size=32, shuffle=False),
+          batch_size=32, sharded_checkpoint_dir=d,
+          checkpoint_every_n_steps=3)
+    # 8 steps/epoch: cadence hits at 3/6/9/12/15 + epoch ends at 8/16
+    steps = sorted(int(s) for s in os.listdir(d) if s.isdigit())
+    assert steps == [3, 6, 8, 9, 12, 15, 16]
+    for step in steps:
+        assert ckpt_mod.validate_step(d, step)
+    # mid-epoch meta carries the step-granular resume state
+    _, _, _, meta, _ = ckpt_mod.load_sharded(d, 12)
+    assert meta["num_update"] == 12 and meta["epoch"] == 1
+    assert meta["batches_done"] == 4
+    assert len(meta["rng_state"]) >= 1
+    # epoch-end snapshots restart the iterator position
+    _, _, _, meta16, _ = ckpt_mod.load_sharded(d, 16)
+    assert meta16["batches_done"] == 0 and meta16["epoch"] == 2
+    # the plane's health surface
+    gauges = telemetry.hub().snapshot()["gauges"]
+    names = {g.split("{")[0] for g in gauges}
+    assert "ckpt_queue_depth" in names
+    assert "ckpt_snapshot_age_steps" in names
+    events = telemetry.hub().events("checkpoint")
+    tiers = {e.get("tier") for e in events}
+    assert "t0" in tiers and "t2" in tiers
+    for e in events:                          # golden keys on every event
+        assert {"step", "seconds", "tier"} <= set(e)
+
+
+def test_acceptance_kill_mid_epoch_bitwise_step_resume(tmp_path,
+                                                       monkeypatch):
+    """ISSUE 17 acceptance: a run hard-killed mid-epoch — torn T2 step
+    and a stray .tmp staging dir left behind, exactly a SIGKILL
+    mid-async-write — resumes at the last durable STEP (not epoch) and
+    the resumed trajectory is bitwise-equal to the uninterrupted run at
+    matching steps (params, optimizer leaves, num_update)."""
+    monkeypatch.setenv("MXNET_TPU_CKPT_KEEP", "100")
+    X, y = _blobs(n=256)
+    batch = 32
+    d_ref = str(tmp_path / "ref")
+
+    def run(d, **kw):
+        m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=2,
+                           optimizer="sgd", learning_rate=0.1)
+        m.fit(mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False),
+              batch_size=batch, sharded_checkpoint_dir=d,
+              checkpoint_every_n_steps=3, **kw)
+        return m
+
+    run(d_ref)  # the uninterrupted reference: steps 3..16 on disk
+
+    # simulate the kill: the victim run died right after step 12 became
+    # durable (mid-epoch 1) — later steps never landed; the in-flight
+    # async write left a torn "15" and a stray .tmp staging dir
+    d_kill = str(tmp_path / "killed")
+    _copy_steps(d_ref, d_kill, (3, 6, 8, 9, 12))
+    shutil.copytree(os.path.join(d_ref, "15"), os.path.join(d_kill, "15"))
+    with open(os.path.join(d_kill, "15", "manifest.json"), "r+b") as f:
+        f.truncate(8)
+    shutil.copytree(os.path.join(d_ref, "16"),
+                    os.path.join(d_kill, ".tmp.16"))
+    assert ckpt_mod.latest_step(d_kill) == 12     # torn 15 skipped
+
+    resumed = run(d_kill)
+    assert resumed.begin_epoch == 1               # resumed, not retrained
+
+    # bitwise at the next cadence step AND at the end of training
+    for step in (15, 16):
+        el = ckpt_mod.load_sharded(d_ref, step)
+        re = ckpt_mod.load_sharded(d_kill, step)
+        for k in el[0]:
+            np.testing.assert_array_equal(el[0][k], re[0][k],
+                                          err_msg=f"params[{k}]@{step}")
+        for i, (a, b) in enumerate(zip(el[4], re[4])):
+            np.testing.assert_array_equal(a, b, err_msg=f"opt[{i}]@{step}")
+        assert el[3]["num_update"] == re[3]["num_update"] == step
+
+
+def test_fit_resize_restores_from_peer_tier_no_disk_read(tmp_path,
+                                                         monkeypatch):
+    """ISSUE 17 acceptance: an elastic shrink with the async plane armed
+    restores from the in-memory T1 tier — load_resharded (the disk path)
+    is never called."""
+    monkeypatch.setenv("MXNET_TPU_CKPT_KEEP", "100")
+    X, y = _blobs(n=480)
+    batch = 48
+    d = str(tmp_path / "el")
+    co = ElasticCoordinator(8)
+    disk_reads = []
+    real = ckpt_mod.load_resharded
+    monkeypatch.setattr(
+        ckpt_mod, "load_resharded",
+        lambda *a, **kw: disk_reads.append(a) or real(*a, **kw))
+
+    def drive(param):
+        if param.epoch == 1 and param.nbatch == 3 and co.world_size == 8:
+            co.kill()
+            co.kill()
+
+    m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=3, optimizer="sgd",
+                       learning_rate=0.1)
+    m.fit(mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False),
+          batch_size=batch, elastic=co, sharded_checkpoint_dir=d,
+          checkpoint_every_n_steps=2, batch_end_callback=drive)
+    assert co.world_size == 6 and co.resizes == 1
+    assert not disk_reads                      # RAM tier, zero disk I/O
+    events = telemetry.hub().events("checkpoint")
+    assert any(e.get("tier") == "t1" for e in events)
+    assert m.score(X, y=y) > 0.95
+
+
+def test_fit_resize_falls_back_to_disk_when_replication_dead(tmp_path,
+                                                             monkeypatch):
+    """Chaos kills every peer replication (the mid-replication SIGKILL):
+    the T1 tier is empty at resize time, so restore falls back to the
+    durable T2 tier — correctness survives, only the disk read returns."""
+    monkeypatch.setenv("MXNET_TPU_CKPT_KEEP", "100")
+    X, y = _blobs(n=480)
+    batch = 48
+    d = str(tmp_path / "el")
+    co = ElasticCoordinator(8)
+    disk_reads = []
+    real = ckpt_mod.load_resharded
+    monkeypatch.setattr(
+        ckpt_mod, "load_resharded",
+        lambda *a, **kw: disk_reads.append(a) or real(*a, **kw))
+
+    def drive(param):
+        if param.epoch == 1 and param.nbatch == 3 and co.world_size == 8:
+            co.kill()
+            co.kill()
+
+    m = mx.FeedForward(_mlp(), ctx=_ctx(8), num_epoch=3, optimizer="sgd",
+                       learning_rate=0.1)
+    with chaos_scope(seed=0, rules={"ckpt.replica": 1.0}):
+        m.fit(mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False),
+              batch_size=batch, elastic=co, sharded_checkpoint_dir=d,
+              checkpoint_every_n_steps=2, batch_end_callback=drive)
+    assert co.world_size == 6
+    assert disk_reads                          # T2 carried the restore
+    assert m.score(X, y=y) > 0.9
+
+
+def test_fit_async_ckpt_zero_recompiles():
+    """ACCEPTANCE: an armed RecompileTracker epoch stays green with
+    step-cadence async checkpointing stacked on compression + overlap +
+    fused-Adam + guards + health — every checkpoint op is host-side, so
+    the step program compiles exactly once."""
+    import tempfile
+
+    X, y = _blobs(160, dim=10)
+    model = mx.FeedForward(_mlp(hidden=64), ctx=_ctx(8), num_epoch=3,
+                           optimizer="adam", fused=True, learning_rate=0.01)
+    tracker = cm.RecompileTracker(raise_on_recompile=True)
+
+    def arm_after_first(epoch, *_):
+        if epoch == 0:
+            tracker.arm()
+
+    cm.reset_compile_stats()
+    with tempfile.TemporaryDirectory() as d:
+        try:
+            model.fit(X, y, batch_size=32, compression="int8", overlap=True,
+                      guards=True, health=True, sharded_checkpoint_dir=d,
+                      checkpoint_every_n_steps=2,
+                      epoch_end_callback=arm_after_first)
+        finally:
+            tracker.disarm()
+        assert ckpt_mod.latest_step(d) is not None
+    assert tracker.recompiles == []
+    per = cm.compile_stats()["per_function"]
+    train = [c for lbl, c in per.items() if lbl.startswith("train_step:")]
+    assert train and train[0]["misses"] == 1  # compiled exactly once
+
+
+# -- the controller's cadence lever --------------------------------------------
+
+def test_select_ckpt_cadence():
+    from mxnet_tpu.resilience.controller import select_ckpt_cadence
+
+    # 0.5s save, 1s steps, 5% target -> every 10 steps
+    assert select_ckpt_cadence(0.5, 1.0, 1) == 10
+    # hysteresis: <25% moves hold the current cadence
+    assert select_ckpt_cadence(0.5, 1.0, 9) == 9
+    assert select_ckpt_cadence(0.5, 1.0, 40) == 10
+    # no measurement, no opinion
+    assert select_ckpt_cadence(None, 1.0, 8) == 8
+    assert select_ckpt_cadence(0.5, None, 8) == 8
+    # clamped to [floor, cap]
+    assert select_ckpt_cadence(1e-9, 1.0, 64, floor=1) == 1
+    assert select_ckpt_cadence(1e9, 1.0, 4, cap=1024) == 1024
+
+
+def test_controller_stages_ckpt_cadence_and_fit_applies():
+    co = ElasticCoordinator(8)
+    ctl = FleetController(interval=0.0, window=8, min_report_steps=8,
+                          rejoin_after=1000.0, evaluate_after=1000.0,
+                          cooldowns={"evict": 1000.0, "backfill": 1000.0,
+                                     "retier": 1000.0, "world": 1000.0,
+                                     "ckpt": 0.0})
+    ctl.bind(coordinator=co, model_key="m", world_size=8, ckpt_every=2)
+    # fleet steps of ~10ms, save cost ~5ms -> 5% target wants every ~10
+    for s in range(16):
+        for r in range(8):
+            telemetry.emit("span", rank=r, name="step", epoch=0, step=s,
+                           dur_ms=10.0,
+                           phases=[{"name": "device", "dur_ms": 10.0}])
+    for _ in range(4):
+        telemetry.observe("checkpoint_save_seconds", 0.005)
+    ctl.tick(now=100.0)
+    action = ctl.take_ckpt_cadence()
+    assert action is not None and action["every"] == 10
+    assert ctl.take_ckpt_cadence() is None     # staged once
+    ctl.ckpt_cadence_applied(action)
+    assert ctl._ckpt_every == 10
+    applied = [d for d in ctl.decisions if d["lever"] == "ckpt"]
+    assert applied and applied[0]["outcome"] == "actuated"
+    events = [e for e in telemetry.hub().events("controller")
+              if e.get("lever") == "ckpt" and e.get("outcome") == "applied"]
+    assert events

@@ -1006,6 +1006,75 @@ def test_self_lint_mx314_clean():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+# -- MX315 checkpoint-discipline fixtures (ISSUE 17 satellite) -----------------
+
+def test_fixture_mx315_direct_save_sharded():
+    # a direct durable write outside the checkpoint plane: races the
+    # async writer's `.tmp.<step>` staging, dodges retention GC and the
+    # `checkpoint` badput pricing
+    src = (
+        "from mxnet_tpu.utils import checkpoint as ck\n"
+        "def snapshot(d, step, params):\n"
+        "    ck.save_sharded(d, step, params)\n"
+    )
+    findings = lint_source(src, "mxnet_tpu/models/fastnet.py")
+    assert [f.rule.id for f in findings] == ["MX315"]
+    assert "durability ordering" in findings[0].message
+
+    # the private staging helpers are just as off-limits
+    src2 = (
+        "from mxnet_tpu.utils.checkpoint import _write_manifest\n"
+        "def stage(d, shards):\n"
+        "    _write_manifest(d, shards)\n"
+    )
+    assert [f.rule.id for f in
+            lint_source(src2, "mxnet_tpu/models/fastnet.py")] == ["MX315"]
+
+
+def test_fixture_mx315_reads_and_sanctioned_paths_clean():
+    # loads / latest_step / the ckpt_async doorway never match
+    src = (
+        "from mxnet_tpu.utils import checkpoint as ck\n"
+        "from mxnet_tpu.resilience import ckpt_async\n"
+        "def resume(d, w):\n"
+        "    step = ck.latest_step(d)\n"
+        "    state = ck.load_sharded(d, step)\n"
+        "    ckpt_async.save_now(d, step, state[0], symbol=None)\n"
+        "    w.submit(None)\n"
+        "    return state\n"
+    )
+    assert lint_source(src, "mxnet_tpu/models/fastnet.py") == []
+
+
+def test_fixture_mx315_pragma_and_owner_exemptions():
+    src = (
+        "from mxnet_tpu.utils import checkpoint as ck\n"
+        "def snapshot(d, step, params):\n"
+        "    ck.save_sharded(d, step, params)"
+        "  # mxlint: disable=MX315 - migration shim, bypasses GC on purpose\n"
+    )
+    assert lint_source(src, "mxnet_tpu/models/fastnet.py") == []
+    # the owner modules ARE the checkpoint plane
+    raw = (
+        "def save_now(d, step, params):\n"
+        "    return save_sharded(d, step, params)\n"
+    )
+    assert lint_source(raw, "mxnet_tpu/utils/checkpoint.py") == []
+    assert lint_source(raw, "mxnet_tpu/resilience/ckpt_async.py") == []
+    # tests drive save_sharded directly all over — exempt
+    assert lint_source(raw, "tests/test_sharded_checkpoint.py") == []
+
+
+def test_self_lint_mx315_clean():
+    """Every durable checkpoint write in the tree flows through the
+    checkpoint plane (utils/checkpoint.py + resilience/ckpt_async.py)."""
+    from mxnet_tpu.analysis.source_lint import lint_paths
+
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX315"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 # -- MX308 unpinned-wire-collective fixtures (ISSUE 7 satellite) ---------------
 
 def test_fixture_mx308_unpinned_collective():

@@ -121,7 +121,7 @@ def test_jsonl_schema_golden_keys(tmp_path):
     span.end()                                   # -> span + step_event
     h.emit("badput", reason="compile", seconds=1.0, epoch=0)
     h.emit("epoch_summary", epoch=0, steps=4, seconds=2.0, goodput_pct=90.0)
-    h.emit("checkpoint", step=3, seconds=0.5)
+    h.emit("checkpoint", step=3, seconds=0.5, tier="t2")
     h.emit("retry", op="push", attempt=1)
     h.emit("circuit_open", op="kvstore")
     h.emit("monitor", rows=7)
