@@ -115,6 +115,22 @@ def with_retries(fn, tries=4, what="tpu op"):
             time.sleep(delay)
 
 
+def _publish(result, filename, smoke=False):
+    """Single exit for every bench headline (ISSUE 20): the per-bench
+    JSON artifact (full runs), a kind="bench" RunRecord in the cross-run
+    ledger when MXNET_TPU_LEDGER_DIR is set, and the combined
+    BENCH_LEDGER_r20.json trajectory. telemetry.ledger.publish_bench is
+    the one writer — no hand-rolled per-bench dumps (mxlint MX316)."""
+    from mxnet_tpu.telemetry import ledger
+
+    out = ledger.publish_bench(
+        result, filename=filename,
+        bench_dir=os.path.dirname(os.path.abspath(__file__)), smoke=smoke)
+    if out["bench_path"]:
+        print(f"wrote {out['bench_path']}", file=sys.stderr)
+    return out
+
+
 def measured_matmul_peak_tflops(n=8192, iters=16, samples=3):
     """This chip's achievable bf16 matmul rate, measured through the same
     tunnel/timing path as the headline number. Slope method: the loop runs
@@ -475,12 +491,7 @@ def run_compile_bench(args):
                    "aot_warm": aot_warm},
     }
     print(json.dumps(result))
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "BENCH_COMPILE_r07.json")
-    with open(out, "w") as f:
-        json.dump(result, f, indent=2)
-        f.write("\n")
-    print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_COMPILE_r07.json")
     shutil.rmtree(base, ignore_errors=True)
 
 
@@ -600,13 +611,7 @@ def run_comm_bench(args):
             "that transfers to bandwidth-bound pods."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_COMM_r08.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_COMM_r08.json", smoke=smoke)
 
 
 def run_overlap_bench(args):
@@ -870,13 +875,7 @@ def run_overlap_bench(args):
             "are reported for completeness only."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_OVERLAP_r11.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_OVERLAP_r11.json", smoke=smoke)
 
 
 def run_telemetry_bench(args):
@@ -987,13 +986,7 @@ def run_telemetry_bench(args):
             "with 100ms+ steps it vanishes."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_TELEMETRY_r09.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_TELEMETRY_r09.json", smoke=smoke)
 
 
 def run_trace_bench(args):
@@ -1115,13 +1108,7 @@ def run_trace_bench(args):
             "dominated by sync on a CPU rig with ~ms steps."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_TRACE_r10.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_TRACE_r10.json", smoke=smoke)
 
 
 def run_mem_bench(args):
@@ -1250,13 +1237,7 @@ def run_mem_bench(args):
             "steps."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_MEM_r12.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_MEM_r12.json", smoke=smoke)
 
 
 def run_health_bench(args):
@@ -1421,13 +1402,7 @@ def run_health_bench(args):
             "HealthMonitor detectors."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_HEALTH_r17.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_HEALTH_r17.json", smoke=smoke)
 
 
 def run_profile_bench(args):
@@ -1557,13 +1532,7 @@ def run_profile_bench(args):
             "priced as `profile` badput, never as throughput."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_PROFILE_r18.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_PROFILE_r18.json", smoke=smoke)
 
 
 def run_elastic_bench(args):
@@ -1683,13 +1652,7 @@ def run_elastic_bench(args):
             "additionally prices the redone partial epoch."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_ELASTIC_r13.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_ELASTIC_r13.json", smoke=smoke)
 
 
 def run_controller_bench(args):
@@ -1935,12 +1898,7 @@ def run_controller_bench(args):
     if not smoke:
         assert recovered is not None and recovered >= 0.3, result
         assert [d.get("rank") for d in evicts] == [straggler], result
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_CONTROLLER_r15.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_CONTROLLER_r15.json", smoke=smoke)
 
 
 def run_kernel_bench(args):
@@ -2149,13 +2107,7 @@ def run_kernel_bench(args):
             "passes."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_KERNELS_r16.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out}", file=sys.stderr)
+    _publish(result, "BENCH_KERNELS_r16.json", smoke=smoke)
 
 
 def run_lockwatch_bench(args):
@@ -2320,13 +2272,7 @@ def run_lockwatch_bench(args):
             "resize fit, overhead <2% of a dp-4 step."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "BENCH_LOCKWATCH_r14.json")
-        with open(out_path, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out_path}", file=sys.stderr)
+    _publish(result, "BENCH_LOCKWATCH_r14.json", smoke=smoke)
 
 
 def run_ckpt_bench(args):
@@ -2503,13 +2449,7 @@ def run_ckpt_bench(args):
             "are the epoch goodput report's `checkpoint` bucket."),
     }
     print(json.dumps(result))
-    if not smoke:
-        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "BENCH_CKPT_r19.json")
-        with open(out_path, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
-        print(f"wrote {out_path}", file=sys.stderr)
+    _publish(result, "BENCH_CKPT_r19.json", smoke=smoke)
 
 
 def main():

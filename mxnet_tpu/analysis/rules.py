@@ -300,6 +300,21 @@ register_rule(
     "`# mxlint: disable=MX315` with a justification")
 
 register_rule(
+    "MX316", "warning",
+    "hand-rolled run-summary emission or direct ledger-dir consultation "
+    "(`emit(\"run_summary\", ...)` / reading `MXNET_TPU_LEDGER_DIR`) "
+    "outside telemetry/ledger.py: the cross-run ledger owns the RunRecord "
+    "schema, the atomic one-file-per-record append discipline (tmp + "
+    "rename + CRC sidecar) and the `run_summary` hub event that announces "
+    "each append — a bypassing writer produces records the trend/compare "
+    "gates cannot read, un-CRC'd files that read_ledger must treat as "
+    "corrupt, and duplicate summary events that skew incident counts",
+    "route run records through telemetry.ledger (record_run / "
+    "append_record / publish_bench) and resolve the store directory via "
+    "telemetry.ledger.ledger_dir(); a deliberate bypass carries "
+    "`# mxlint: disable=MX316` with a justification")
+
+register_rule(
     "MX306", "warning",
     "un-barriered wall-clock delta around device dispatch: a "
     "time.time()/perf_counter() start/stop pair with work between and no "

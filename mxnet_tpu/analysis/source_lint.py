@@ -46,6 +46,13 @@ Catches, before anything imports or traces:
                race the async writer and dodge badput pricing
                (ckpt_async.save_now / AsyncCheckpointWriter.submit are
                the sanctioned shapes),
+  MX316        hand-rolled run-summary emission (emit("run_summary", ...))
+               or direct MXNET_TPU_LEDGER_DIR consultation outside
+               telemetry/ledger.py — the cross-run ledger owns the
+               RunRecord schema and the atomic CRC'd append, so strays
+               produce history the trend/compare gates cannot read
+               (telemetry.ledger.record_run / publish_bench /
+               ledger_dir() are the sanctioned shapes),
   MX601-602    robustness hazards (bare ``except:``; ``while True`` retry
                loops that swallow exceptions with no backoff/deadline —
                the loop shape that melts a parameter server under a
@@ -1234,6 +1241,76 @@ def _scan_checkpoint_discipline(tree, path, findings):
             path=path, line=node.lineno, col=node.col_offset))
 
 
+# -- MX316: run-ledger discipline (ISSUE 20) ----------------------------------
+# Every RunRecord flows through telemetry/ledger.py: distill() owns the
+# schema, append_record() the atomic one-file-per-record write (tmp +
+# rename + CRC sidecar via utils.checkpoint.atomic_write) and the
+# `run_summary` announcement event. A module that reads
+# MXNET_TPU_LEDGER_DIR itself (to write its own files there) or emits its
+# own `run_summary` events produces history the trend/compare gates cannot
+# read. Zero-FP-biased: fires only on (a) an `emit`/`.emit` call whose
+# first positional argument is the literal "run_summary", and (b) an
+# os.environ get/[] whose key is the literal "MXNET_TPU_LEDGER_DIR" —
+# `monkeypatch.setenv` and docstrings never match; owner + tests exempt.
+
+_MX316_OWNER_FILES = ("ledger.py",)
+_MX316_ENV_KEY = "MXNET_TPU_LEDGER_DIR"
+_MX316_ENV_GETTERS = ("get", "getenv", "pop", "setdefault")
+
+
+def _mx316_exempt(path: str) -> bool:
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if any(p in ("tests", "examples", "fixtures") for p in parts):
+        return True
+    base = os.path.basename(norm)
+    return base in _MX316_OWNER_FILES or base.startswith("test_")
+
+
+def _const_eq(node, value) -> bool:
+    return isinstance(node, ast.Constant) and node.value == value
+
+
+def _scan_ledger_discipline(tree, path, findings):
+    if _mx316_exempt(path):
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript):
+            # os.environ["MXNET_TPU_LEDGER_DIR"] in any read/write position
+            if _const_eq(getattr(node, "slice", None), _MX316_ENV_KEY):
+                findings.append(Finding(
+                    get_rule("MX316"),
+                    f"direct `{_MX316_ENV_KEY}` subscript outside "
+                    "telemetry/ledger.py — resolve the store through "
+                    "telemetry.ledger.ledger_dir() so every record lands "
+                    "via the atomic CRC'd writer",
+                    path=path, line=node.lineno, col=node.col_offset))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            getattr(f, "id", None)
+        if name == "emit" and node.args and \
+                _const_eq(node.args[0], "run_summary"):
+            findings.append(Finding(
+                get_rule("MX316"),
+                "hand-rolled `run_summary` emission outside "
+                "telemetry/ledger.py — the ledger announces each append "
+                "itself (append_record); a duplicate summary event skews "
+                "the golden-key stream and incident counts",
+                path=path, line=node.lineno, col=node.col_offset))
+        elif name in _MX316_ENV_GETTERS and node.args and \
+                _const_eq(node.args[0], _MX316_ENV_KEY):
+            findings.append(Finding(
+                get_rule("MX316"),
+                f"direct `{_MX316_ENV_KEY}` consultation outside "
+                "telemetry/ledger.py — resolve the store through "
+                "telemetry.ledger.ledger_dir() (one writer, one reader "
+                "discipline; see telemetry/ledger.py)",
+                path=path, line=node.lineno, col=node.col_offset))
+
+
 # calls whose presence inside a retry loop counts as bounding it: anything
 # sleep/backoff/wait-shaped (time.sleep, policy backoff, cv.wait_for, ...)
 _BOUNDING_CALL_PARTS = ("sleep", "backoff", "wait", "delay", "retry_call",
@@ -1443,6 +1520,7 @@ def lint_source(text: str, path: str = "<string>") -> list[Finding]:
     _scan_kernel_discipline(tree, path, scan.findings)
     _scan_profiler_discipline(tree, path, scan.findings)
     _scan_checkpoint_discipline(tree, path, scan.findings)
+    _scan_ledger_discipline(tree, path, scan.findings)
     _scan_placement_discipline(tree, path, scan.findings)
 
     roots: list[ast.AST] = list(scan.traced_lambdas)

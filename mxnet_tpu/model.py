@@ -34,6 +34,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import sys
 import time
 
 import jax
@@ -1641,6 +1642,15 @@ class FeedForward(BASE_ESTIMATOR):
                 telemetry_mod.memory.attach_sampler()
         self._active_timeline = tl
 
+        # -- cross-run ledger (ISSUE 20): window anchors for the
+        # end-of-run RunRecord. The hub ring outlives one fit (tests run
+        # many per process), so distillation is bounded to events after
+        # this hub timestamp; comm bytes are recorded as the delta
+        # against the registry totals captured here.
+        _ledger_t0 = telemetry_mod.hub().now()
+        _ledger_tic = time.time()
+        _ledger_comm0 = comm_mod.registry().stats()
+
         # -- device-time profiler (ISSUE 15): one bounded capture window,
         # attributed to layers/kernels through the named-scope metadata ----
         prof_session = None
@@ -2592,6 +2602,42 @@ class FeedForward(BASE_ESTIMATOR):
             if mem_prev is not None:
                 telemetry_mod.memory.detach_sampler()
                 telemetry_mod.track_arrays(mem_prev)
+            # -- cross-run ledger (ISSUE 20): distill this run into one
+            # persistent RunRecord. comm_spec reflects the FINAL tier
+            # (_apply_retier rebinds it via nonlocal), so the knob vector
+            # records what the run actually ended on. Best-effort: the
+            # ledger must never mask the run's own outcome.
+            try:
+                _lc = comm_spec if comm_spec is not None else async_comm_spec
+                try:
+                    _fused = bool(optimizer._fused_active())
+                except Exception:
+                    _fused = False
+                telemetry_mod.ledger.record_run(
+                    "fit",
+                    fingerprint=str(self._fingerprint_for_bucket(None)),
+                    world_size=(int(mesh.shape["dp"])
+                                if mesh is not None else 1),
+                    knobs={
+                        "compression": _lc.mode if _lc is not None else "none",
+                        "overlap_bytes": (overlap_cfg.bucket_bytes
+                                          if overlap_cfg is not None else None),
+                        "comm_kernels": kern_cfg is not None,
+                        "fused_adam": _fused,
+                        "pad_policy": (pad_policy.mode
+                                       if pad_policy is not None else None),
+                        "health": health_cfg is not None,
+                        "profile": profile_cfg is not None,
+                        "guards": guard_cfg is not None,
+                        "ckpt_every": ckpt_every,
+                    },
+                    completed=sys.exc_info()[0] is None,
+                    since_ts=_ledger_t0,
+                    comm_start=_ledger_comm0,
+                    wall_seconds=time.time() - _ledger_tic,
+                    logger=logger)
+            except Exception as e:
+                logger.warning("telemetry ledger: run record failed: %s", e)
         return self
 
     # -- AOT warmup -----------------------------------------------------------
@@ -3038,6 +3084,9 @@ class FeedForward(BASE_ESTIMATOR):
                 num_devices=1, owner="predict")
         data_iter = _init_iter(X, None, batch_size, is_train=False)
         data_names = [x[0] for x in data_iter.provide_data]
+        # cross-run ledger (ISSUE 20): same window anchors as fit()
+        _ledger_t0 = telemetry_mod.hub().now()
+        _ledger_tic = time.time()
         if self.arg_params is None:
             raise MXNetError("model has no parameters; fit() or load first")
         params = {k: v.data for k, v in self.arg_params.items()}
@@ -3090,6 +3139,21 @@ class FeedForward(BASE_ESTIMATOR):
             if prof_session is not None:
                 prof_session.close()  # short datasets close a partial window
                 self.profile_report = prof_session.report
+            try:
+                # cross-run ledger (ISSUE 20): inference runs land in the
+                # same store as fits, keyed kind="predict"
+                telemetry_mod.ledger.record_run(
+                    "predict",
+                    fingerprint=str(self._fingerprint_for_bucket(None)),
+                    world_size=1,
+                    knobs={"profile": profile_cfg is not None},
+                    completed=sys.exc_info()[0] is None,
+                    since_ts=_ledger_t0,
+                    span_name="predict_step",
+                    wall_seconds=time.time() - _ledger_tic)
+            except Exception as e:
+                logging.warning(
+                    "telemetry ledger: run record failed: %s", e)
         results = [np.concatenate(lst, axis=0) for lst in chunks]
         return results[0] if len(results) == 1 else results
 

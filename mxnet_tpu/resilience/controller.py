@@ -356,6 +356,32 @@ class FleetController:
                 else max(1, int(ckpt_every))
             self._pending_ckpt = None
         self.detector.attach()
+        # -- ledger warm-start (ISSUE 20): a read-only sensor. When this
+        # (model, world) has trained before, seed the tier cache with the
+        # historically best compression mode from the cross-run ledger so
+        # the retier lever's first proposal starts from evidence instead
+        # of the static default. Never actuates here — the normal lever
+        # path (rate limits, dry-run, regression evals) still governs.
+        if self._can_retier and model_key is not None and \
+                (model_key, self._bound_world) not in self._tier_cache:
+            try:
+                from ..telemetry import ledger as ledger_mod
+
+                hist = ledger_mod.warm_start_tier(
+                    str(model_key), self._bound_world)
+            except Exception:
+                hist = None
+            if hist is not None and hist.get("mode") and \
+                    hist["mode"] != self._comm_mode:
+                with self._lock:
+                    self._tier_cache[(model_key, self._bound_world)] = \
+                        hist["mode"]
+                self._emit(
+                    "retier",
+                    f"warm-start tier {hist['mode']} from ledger "
+                    f"({hist.get('runs', 0)} prior runs)",
+                    "warm_start", force=True, mode=hist["mode"],
+                    record_id=hist.get("record_id"))
         if coordinator is None and (self.cfg.auto_evict or
                                     self.cfg.auto_world):
             (logger or logging).info(

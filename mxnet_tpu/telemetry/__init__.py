@@ -56,6 +56,9 @@ from . import health
 from .health import HealthConfig, HealthMonitor
 from . import profiling
 from .profiling import ProfileConfig, ProfileSession
+from . import ledger
+from .ledger import (LEDGER_SCHEMA, ledger_dir, read_ledger, trend_gate,
+                     knob_attribution, warm_start_tier)
 
 # the black box records from import on (and survives hub resets)
 flight.install()
@@ -82,6 +85,8 @@ __all__ = [
     "sensors", "StreamingStragglerDetector", "comm_compute_ratio",
     "health", "HealthConfig", "HealthMonitor",
     "profiling", "ProfileConfig", "ProfileSession",
+    "ledger", "LEDGER_SCHEMA", "ledger_dir", "read_ledger", "trend_gate",
+    "knob_attribution", "warm_start_tier",
     "counter", "gauge", "observe", "emit", "TelemetryConfig",
     "maybe_serve_http_from_env",
 ]

@@ -9,6 +9,11 @@
     python -m mxnet_tpu.telemetry profile run.jsonl [-n 20]
     python -m mxnet_tpu.telemetry flight show dump.json [-n 10]
     python -m mxnet_tpu.telemetry flight validate dump.json
+    python -m mxnet_tpu.telemetry ledger list [--dir D] [--fingerprint F]
+    python -m mxnet_tpu.telemetry ledger show <record-id>
+    python -m mxnet_tpu.telemetry ledger trend [--fingerprint F] [-n 8]
+    python -m mxnet_tpu.telemetry ledger compare [--fingerprint F]
+    python -m mxnet_tpu.telemetry ledger regress [--fingerprint F]
 
 ``tail`` prints the last N events; ``summarize`` digests one file (events
 per kind, span/phase time totals, badput buckets, MFU/goodput lines).
@@ -29,9 +34,15 @@ roofline rows (``source: "measured"``), and the measured-vs-modeled MFU
 reconciliation; ``diff`` additionally gates the last capture's top per-op
 times, so a hotspot regression fails CI like a step-time regression.
 ``flight`` renders and CRC-validates flight-recorder dumps (including the
-memory snapshot and last-profile sections). All readers take schema v1
-(PR 5) and v2 (distributed tracing) files; v1 rows read as rank 0 of
-world 1.
+memory snapshot and last-profile sections). ``ledger`` reads the
+cross-run store under ``MXNET_TPU_LEDGER_DIR`` (ISSUE 20): ``trend``
+gates the newest matching-fingerprint record against the median of its
+last-N predecessors (exit 3 on regression — the N-run successor to
+pairwise ``diff``), ``regress`` is the pairwise newest-vs-previous form,
+and ``compare`` pairs records that differ in exactly one knob and
+attributes the step-time/wire-byte delta to that knob. All readers take
+schema v1 (PR 5) and v2 (distributed tracing) files; v1 rows read as
+rank 0 of world 1.
 """
 
 from __future__ import annotations
@@ -413,8 +424,8 @@ def cmd_flight(args):
     # show: the post-mortem rendering
     print(f"flight dump {args.path}")
     print(f"  reason={payload.get('reason')} rank={payload.get('rank')}/"
-          f"{payload.get('world_size')} trace={payload.get('trace_id')} "
-          f"pid={payload.get('pid')}")
+          f"{payload.get('world_size')} run={payload.get('run_id')} "
+          f"trace={payload.get('trace_id')} pid={payload.get('pid')}")
     steps = payload.get("steps", [])
     print(f"last {min(args.n, len(steps))} of {len(steps)} recorded steps:")
     for s in steps[-args.n:]:
@@ -480,6 +491,112 @@ def cmd_flight(args):
     return 0
 
 
+def _ledger_records(args):
+    """(records, directory) for the ledger subcommands, identity-filtered
+    by the common --fingerprint/--world/--backend/--kind flags."""
+    from . import ledger as ledger_mod
+
+    directory = ledger_mod.ledger_dir(args.dir)
+    if directory is None:
+        print("error: no ledger directory (pass --dir or set "
+              "MXNET_TPU_LEDGER_DIR)", file=sys.stderr)
+        return None, None
+    records = ledger_mod.read_ledger(directory)
+    records = ledger_mod.match(
+        records, fingerprint=args.fingerprint, kind=args.kind,
+        world=args.world, backend=args.backend)
+    return records, directory
+
+
+def _fmt_record(r):
+    o = r.get("outcomes", {})
+    p50 = o.get("step_ms_p50")
+    mfu = o.get("mfu_pct")
+    knobs = r.get("knobs", {})
+    return (f"{r.get('record_id', '?'):<18s} {r.get('kind', '?'):<8s} "
+            f"fp={str(r.get('fingerprint'))[:12]:<12s} "
+            f"w={r.get('world_size', '?'):<3} "
+            f"{r.get('backend', '?'):<5s} "
+            f"tier={str(knobs.get('compression')):<6s} "
+            + (f"p50={p50:8.2f}ms " if isinstance(p50, (int, float))
+               else f"{'':14s}")
+            + (f"mfu={mfu:5.1f}% " if isinstance(mfu, (int, float))
+               else "")
+            + ("" if r.get("completed", True) else " INCOMPLETE"))
+
+
+def cmd_ledger(args):
+    from . import ledger as ledger_mod
+
+    records, directory = _ledger_records(args)
+    if records is None:
+        return 2
+    if args.action == "list":
+        if not records:
+            print(f"{directory}: no matching ledger records")
+            return 1
+        for r in records[-args.n:]:
+            print(_fmt_record(r))
+        print(f"{len(records)} record(s) in {directory}")
+        return 0
+    if args.action == "show":
+        if not args.record:
+            print("error: ledger show needs a record id", file=sys.stderr)
+            return 2
+        hits = [r for r in records
+                if str(r.get("record_id", "")).startswith(args.record)
+                or str(r.get("run_id", "")).startswith(args.record)]
+        if not hits:
+            print(f"error: no record matching {args.record!r} in "
+                  f"{directory}", file=sys.stderr)
+            return 1
+        for r in hits:
+            r = dict(r)
+            r.pop("_path", None)
+            print(json.dumps(r, indent=2, sort_keys=True, default=str))
+        return 0
+    if args.action in ("trend", "regress"):
+        window = 2 if args.action == "regress" else args.n
+        report = ledger_mod.trend_gate(records, metric=args.metric,
+                                       n=window, threshold=args.threshold)
+        if "reason" in report:
+            print(f"{args.metric}: not gated ({report['reason']})")
+            return 0
+        worse = "higher" if ledger_mod.metric_direction(args.metric) \
+            else "lower"
+        print(f"{args.metric} over last {report['n']} matching record(s) "
+              f"({worse} is worse):")
+        for r in records[-window:]:
+            print("  " + _fmt_record(r))
+        print(f"baseline (median of prior) = {report['baseline']:.3f}, "
+              f"latest = {report['latest']:.3f}, "
+              f"delta = {report['delta_pct']:+.1f}%")
+        if report["regressed"]:
+            print(f"REGRESSION: {args.metric} moved "
+                  f"{report['delta_pct']:+.1f}% (> {args.threshold:g}% "
+                  f"threshold) on record {report['latest_record']}",
+                  file=sys.stderr)
+            return 3
+        return 0
+    # compare: knob attribution over single-knob-delta record pairs
+    rows = ledger_mod.knob_attribution(records)
+    if not rows:
+        print("no record pairs differing in exactly one knob "
+              f"({len(records)} matching record(s))")
+        return 1
+    for row in rows:
+        deltas = "  ".join(
+            f"{m}: {d['a']:.3f} -> {d['b']:.3f} ({d['delta_pct']:+.1f}%)"
+            for m, d in sorted(row["deltas"].items()))
+        print(f"knob {row['knob']}: {row['a_value']!r} -> "
+              f"{row['b_value']!r}  [{row['a_record']} vs "
+              f"{row['b_record']}]")
+        print(f"  {deltas}")
+    print(f"{len(rows)} single-knob pair(s); the delta is attributable "
+          f"to the named knob (identity and every other knob matched)")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m mxnet_tpu.telemetry",
                                  description=__doc__,
@@ -534,6 +651,30 @@ def main(argv=None):
     f.add_argument("path")
     f.add_argument("-n", type=int, default=10)
     f.set_defaults(fn=cmd_flight)
+    lg = sub.add_parser("ledger", help="cross-run store: list/show "
+                                       "records, N-run trend gate (exit "
+                                       "3 on regression), single-knob "
+                                       "delta attribution")
+    lg.add_argument("action", choices=("list", "show", "trend", "compare",
+                                       "regress"))
+    lg.add_argument("record", nargs="?", default=None,
+                    help="record/run id prefix (show)")
+    lg.add_argument("--dir", default=None,
+                    help="ledger directory (default: MXNET_TPU_LEDGER_DIR)")
+    lg.add_argument("--fingerprint", default=None,
+                    help="gate/compare only records of this graph "
+                         "fingerprint")
+    lg.add_argument("--kind", default=None,
+                    choices=("fit", "predict", "bench"))
+    lg.add_argument("--world", type=int, default=None)
+    lg.add_argument("--backend", default=None)
+    lg.add_argument("--metric", default="step_ms_p50",
+                    help="gated outcome (default step_ms_p50)")
+    lg.add_argument("-n", type=int, default=8,
+                    help="trend window / list tail length")
+    lg.add_argument("--threshold", type=float, default=10.0,
+                    help="regression threshold in percent (default 10)")
+    lg.set_defaults(fn=cmd_ledger)
     args = ap.parse_args(argv)
     try:
         return args.fn(args)

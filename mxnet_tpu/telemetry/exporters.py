@@ -73,6 +73,10 @@ EVENT_GOLDEN_KEYS = {
     # summary (phase = "start" | "capture" | "summary"; summaries carry
     # the hotspot table, per-layer ms, measured roofline + MFU blocks)
     "profile": ("phase", "steps", "device_ms", "coverage_pct"),
+    # cross-run ledger (ISSUE 20): one event per appended RunRecord —
+    # the in-stream pointer joining a JSONL trace to its ledger entry
+    # (source = "fit" | "predict" | "bench")
+    "run_summary": ("run_id", "fingerprint", "backend", "source"),
 }
 
 
@@ -131,6 +135,9 @@ def read_events(path):
     merge consume old and new logs uniformly."""
     rows = read_jsonl(path)
     for row in rows:
+        # run identity (ISSUE 20) postdates both schemas additively:
+        # rows written before hubs minted run_ids read as "no run id"
+        row.setdefault("run_id", None)
         if int(row.get("v", 1)) < 2:
             row.setdefault("rank", 0)
             row.setdefault("world_size", 1)
@@ -150,6 +157,13 @@ def read_events(path):
             # pre-PR-17 rows predate the multi-tier plane: everything was
             # a synchronous durable-disk save
             row.setdefault("tier", "t2")
+        elif row.get("kind") == "run_summary":
+            # rows from early/hand-rolled producers: fill the additive
+            # identity fields so ledger joins degrade to None, not KeyError
+            row.setdefault("fingerprint", None)
+            row.setdefault("backend", None)
+            row.setdefault("source", "fit")
+            row.setdefault("record_id", None)
         elif row.get("kind") == "profile":
             # rows from early/hand-rolled producers (ISSUE 15): fill the
             # additive fields so the CLI/diff consume old streams uniformly

@@ -158,6 +158,9 @@ class FlightRecorder:
         payload = {
             "format": FLIGHT_FORMAT,
             "v": SCHEMA_VERSION,
+            # run identity (ISSUE 20): the dump joins to ledger records
+            # and event streams on run_id (old dumps simply lack the key)
+            "run_id": getattr(h, "run_id", None),
             "trace_id": dist_mod.trace_id(),
             "rank": rank,
             "world_size": dist_mod.world_size(),

@@ -28,6 +28,7 @@ any layer without cycles.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 
@@ -145,6 +146,12 @@ class MetricsHub:
     --telemetry-bench asserts it stays under 2% of a smoke-run step)."""
 
     def __init__(self, ring_size=8192):
+        # run identity (ISSUE 20): every hub mints one — unlike trace_id,
+        # which only distributed runs adopt from rank 0 — so single-
+        # process runs, tests, and bench invocations all carry a joinable
+        # id on their events, flight dumps, and ledger records. reset()
+        # builds a fresh hub, so a fresh run_id.
+        self.run_id = os.urandom(6).hex()
         self._lock = named_lock("telemetry.hub.MetricsHub")
         self._counters = {}          # (name, labelkey) -> float
         self._gauges = {}            # (name, labelkey) -> float
@@ -199,8 +206,11 @@ class MetricsHub:
         Every event is stamped with the emitting rank/world_size (explicit
         fields win — a server emitting on behalf of a worker labels it)."""
         rank, world = _rank_world()
-        # kind/ts are the envelope and always win over payload fields
-        event = {"rank": rank, "world_size": world,
+        # kind/ts are the envelope and always win over payload fields;
+        # rank/world/run_id are identity defaults explicit fields may
+        # override (a server emitting on behalf of a worker, a replayed
+        # stream keeping its original run)
+        event = {"rank": rank, "world_size": world, "run_id": self.run_id,
                  **fields, "kind": kind, "ts": self.now()}
         with self._lock:
             self._events.append(event)

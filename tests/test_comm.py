@@ -258,15 +258,20 @@ def test_make_data_parallel_step_compression_parity():
         spec = comm.CompressionSpec.resolve(mode)
         step = par.make_data_parallel_step(loss_fn, update_fn, mesh,
                                            donate=False, compression=mode)
+        # block every step: on single-core CI hosts, letting 60 collective
+        # programs pile up in async dispatch interleaves their in-process
+        # rendezvous on the 8-device clique and XLA:CPU can deadlock
         if spec is not None and spec.error_feedback:
             state = jax.device_put(
                 comm.init_error_feedback(params, spec, 8),
                 NamedSharding(mesh, P("dp")))
             for _ in range(steps):
                 params, _, loss, state = step(params, {}, batch, state)
+                jax.block_until_ready(loss)
         else:
             for _ in range(steps):
                 params, _, loss = step(params, {}, batch)
+                jax.block_until_ready(loss)
         return float(loss), np.asarray(params["w"])
 
     loss_ref, w_ref = train(None)

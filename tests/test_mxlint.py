@@ -1075,6 +1075,83 @@ def test_self_lint_mx315_clean():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+# -- MX316 run-ledger-discipline fixtures (ISSUE 20 satellite) -----------------
+
+def test_fixture_mx316_env_consultation_and_summary_emit():
+    # a layer resolving the ledger dir itself to write its own summary
+    # file: un-CRC'd records the trend/compare gates cannot read, plus a
+    # duplicate run_summary event skewing the golden-key stream
+    src = (
+        "import os, json\n"
+        "def summarize(hub, outcomes):\n"
+        "    d = os.environ.get('MXNET_TPU_LEDGER_DIR')\n"
+        "    with open(os.path.join(d, 'summary.json'), 'w') as f:\n"
+        "        json.dump(outcomes, f)\n"
+        "    hub.emit('run_summary', run_id='abc')\n"
+    )
+    findings = lint_source(src, "mxnet_tpu/models/fastnet.py")
+    assert [f.rule.id for f in findings] == ["MX316", "MX316"]
+    assert "ledger_dir()" in findings[0].message
+    assert "run_summary" in findings[1].message
+
+    # writing the env var directly is the same bypass
+    src2 = (
+        "import os\n"
+        "def redirect(d):\n"
+        "    os.environ['MXNET_TPU_LEDGER_DIR'] = d\n"
+    )
+    assert [f.rule.id for f in
+            lint_source(src2, "mxnet_tpu/models/fastnet.py")] == ["MX316"]
+
+
+def test_fixture_mx316_sanctioned_paths_clean():
+    # the sanctioned shapes: ledger_dir()/record_run/publish_bench, other
+    # env vars, other emit kinds — and monkeypatch.setenv (keyword "key"
+    # position is not the getter-call shape MX316 matches)
+    src = (
+        "import os\n"
+        "def ok(hub, monkeypatch):\n"
+        "    from mxnet_tpu.telemetry import ledger\n"
+        "    monkeypatch.setenv('MXNET_TPU_LEDGER_DIR', '/tmp/x')\n"
+        "    d = ledger.ledger_dir()\n"
+        "    ledger.record_run('fit', fingerprint='fp')\n"
+        "    flight = os.environ.get('MXNET_TPU_FLIGHT_DIR')\n"
+        "    hub.emit('epoch_summary', mfu_pct=1.0)\n"
+    )
+    assert lint_source(src, "mxnet_tpu/models/fastnet.py") == []
+
+
+def test_fixture_mx316_pragma_and_owner_exemptions():
+    src = (
+        "import os\n"
+        "def probe(hub):\n"
+        "    d = os.environ.get('MXNET_TPU_LEDGER_DIR')"
+        "  # mxlint: disable=MX316 - launcher probe, read-only\n"
+    )
+    assert lint_source(src, "mxnet_tpu/models/fastnet.py") == []
+    # the owner module IS the ledger
+    raw = (
+        "import os\n"
+        "def ledger_dir():\n"
+        "    return os.environ.get('MXNET_TPU_LEDGER_DIR') or None\n"
+        "def announce(hub, rec):\n"
+        "    hub.emit('run_summary', run_id=rec['run_id'])\n"
+    )
+    assert lint_source(raw, "mxnet_tpu/telemetry/ledger.py") == []
+    # tests point the store at tmpdirs constantly — exempt
+    assert lint_source(raw, "tests/test_ledger.py") == []
+
+
+def test_self_lint_mx316_clean():
+    """Every run-summary write in the tree flows through
+    telemetry/ledger.py (the one writer the gates can read)."""
+    from mxnet_tpu.analysis.source_lint import lint_paths
+
+    findings = [f for f in lint_paths([os.path.join(REPO, "mxnet_tpu")])
+                if f.rule.id == "MX316"]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 # -- MX308 unpinned-wire-collective fixtures (ISSUE 7 satellite) ---------------
 
 def test_fixture_mx308_unpinned_collective():

@@ -168,6 +168,11 @@ def test_jsonl_schema_golden_keys(tmp_path):
            device_ms=12.5, coverage_pct=91.2, window_seconds=0.05,
            unattributed_ms=1.1,
            top=[{"layer": "fc1", "op": "dot_general", "us": 9000.0}])
+    # cross-run ledger kind (ISSUE 20): append_record announces each
+    # persisted RunRecord through the hub itself
+    rec = telemetry.ledger.distill("fit", fingerprint="fp-golden",
+                                   world_size=1)
+    telemetry.ledger.append_record(rec, directory=str(tmp_path / "ledger"))
     path = str(tmp_path / "events.jsonl")
     telemetry.write_jsonl(path, h.events())
     rows = telemetry.read_jsonl(path)
@@ -199,6 +204,8 @@ def test_read_events_v1_backward_compat(tmp_path):
                             "op": "push", "attempt": 0}) + "\n")
     rows = telemetry.read_events(path)
     assert all(r["rank"] == 0 and r["world_size"] == 1 for r in rows)
+    # pre-ledger files (ISSUE 20): every row backfills run_id=None
+    assert all(r["run_id"] is None for r in rows)
     span = rows[0]
     assert span["span_id"] is None and span["trace_id"] is None
     assert span["wall_ts"] == span["ts"]
